@@ -1,0 +1,23 @@
+# Repo-root conftest: makes `ray_tpu` importable and pins JAX to a virtual
+# 8-device CPU mesh for tests (multi-chip sharding is validated on CPU; the
+# real chip is reserved for bench.py).
+#
+# Note: this machine's sitecustomize registers the TPU backend and forces
+# jax.config jax_platforms="axon,cpu" at interpreter start, so env vars
+# alone don't stick — override through jax.config before any backend
+# initializes.
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,77 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Tasks, actors, a shared-memory object store, resource scheduling and
+placement groups as the host-side substrate (the reference architecture
+of wallies/ray, rebuilt — see SURVEY.md), with jax/XLA/pjit/pallas as
+the accelerator path: SPMD programs over device meshes, in-graph XLA
+collectives over ICI, pallas kernels for long-context attention.
+
+Public API mirrors the reference's `ray` package:
+
+    import ray_tpu
+
+    ray_tpu.init()
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    ray_tpu.get(f.remote(2))  # 4
+"""
+from __future__ import annotations
+
+import inspect as _inspect
+
+from ._private.worker import (  # noqa: F401
+    available_resources,
+    cluster_resources,
+    free,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    wait,
+)
+from .actor import ActorClass, ActorHandle  # noqa: F401
+from .object_ref import ObjectRef  # noqa: F401
+from .remote_function import RemoteFunction  # noqa: F401
+from . import exceptions  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def remote(*args, **kwargs):
+    """Turn a function into a remote task or a class into an actor class.
+
+    Usable bare (``@remote``) or with options
+    (``@remote(num_cpus=2, num_tpus=1)``) — reference:
+    _private/worker.py:132-376 overloads.
+    """
+
+    def _make(target):
+        if _inspect.isclass(target):
+            return ActorClass(target, **kwargs)
+        if callable(target):
+            return RemoteFunction(target, **kwargs)
+        raise TypeError(f"@remote target must be a function or class: {target}")
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or _inspect.isclass(args[0])):
+        return _make(args[0])
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return _make
+
+
+def method(**kwargs):
+    """Decorator for actor methods carrying default options
+    (reference: ray.method)."""
+
+    def deco(fn):
+        fn.__ray_method_options__ = kwargs
+        return fn
+
+    return deco

@@ -1,0 +1,214 @@
+"""Core client: the library linked into every driver and worker process.
+
+Reference: the CoreWorker library (src/ray/core_worker/core_worker.h:292)
+— submission, object get/put/wait, KV access — minus the execution loop,
+which lives in worker_main. One instance per process, connected to the
+GCS over the session's unix socket.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import serialization
+from .config import RayConfig
+from .ids import ObjectID, WorkerID
+from .object_store import ObjectStore
+from .protocol import ConnectionLost, PeerConn
+from .task_spec import TaskSpec
+from ..exceptions import GetTimeoutError, RayTaskError, RayTpuError
+from ..object_ref import ObjectRef
+
+
+class CoreClient:
+    def __init__(
+        self,
+        address: str,
+        authkey: bytes,
+        role: str,
+        worker_id: Optional[WorkerID] = None,
+        push_handler: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ):
+        from multiprocessing.connection import Client as MpClient
+
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.role = role
+        self.store = ObjectStore()
+        self._push_handler = push_handler or (lambda msg: None)
+        conn = MpClient(address, family="AF_UNIX", authkey=authkey)
+        self.conn = PeerConn(conn, push_handler=self._on_push, name=f"client-{role}")
+        reply = self.conn.request(
+            {
+                "type": "hello",
+                "role": role,
+                "worker_id": self.worker_id.binary(),
+                "pid": os.getpid(),
+            },
+            timeout=RayConfig.worker_register_timeout_s,
+        )
+        if not reply.get("ok"):
+            raise RayTpuError(f"failed to register with GCS: {reply}")
+        self.session_dir = reply["session_dir"]
+        self._registered_functions: set = set()
+        self._fn_lock = threading.Lock()
+
+    def _on_push(self, msg: Dict[str, Any]):
+        self._push_handler(msg)
+
+    # ------------------------------------------------------------------ submit
+
+    def register_function_once(self, function_id: bytes, blob: bytes) -> Optional[bytes]:
+        """Returns the blob if this client hasn't shipped it yet, else None."""
+        with self._fn_lock:
+            if function_id in self._registered_functions:
+                return None
+            self._registered_functions.add(function_id)
+            return blob
+
+    def fetch_function(self, function_id: bytes) -> bytes:
+        reply = self.conn.request({"type": "get_function", "function_id": function_id})
+        if not reply.get("ok"):
+            raise RayTpuError(f"function {function_id.hex()} not found in GCS")
+        return reply["blob"]
+
+    def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self.conn.send({"type": "submit_task", "spec": spec})
+        owner = self.worker_id.binary()
+        return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+
+    # ------------------------------------------------------------------ objects
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        self.put_with_id(oid, value)
+        return ObjectRef(oid, self.worker_id.binary())
+
+    def put_with_id(self, oid: ObjectID, value: Any) -> Dict[str, Any]:
+        """Seal a value; small values inline through the GCS, large ones go
+        to the shm store (reference: max_direct_call_object_size split
+        between memory store and plasma)."""
+        value = serialization.prepare_value(value)
+        payload, buffers = serialization.dumps(value)
+        size = serialization.serialized_size(payload, buffers)
+        if size <= RayConfig.max_inline_object_size:
+            blob = bytearray(size)
+            serialization.write_to(memoryview(blob), payload, buffers)
+            fields = {"object_id": oid.binary(), "inline": bytes(blob), "size": size}
+        else:
+            name = object_segment_put(self.store, oid, payload, buffers, size)
+            fields = {"object_id": oid.binary(), "segment": name, "size": size}
+        reply = self.conn.request({"type": "put_object", **fields})
+        if not reply.get("ok"):
+            raise RayTpuError(f"put failed: {reply}")
+        return fields
+
+    def _materialize(self, reply: Dict[str, Any], oid: ObjectID) -> Any:
+        if reply.get("status") == "FAILED":
+            err = serialization.unpack(reply["error"])
+            if isinstance(err, RayTaskError):
+                raise err.as_instanceof_cause()
+            raise err
+        if reply.get("inline") is not None:
+            return serialization.unpack(reply["inline"])
+        return self.store.get(oid)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(f"get timed out on {ref}")
+            try:
+                reply = self.conn.request(
+                    {"type": "get_object", "object_id": ref.id().binary()},
+                    timeout=remaining,
+                )
+            except TimeoutError:
+                raise GetTimeoutError(f"get timed out on {ref}") from None
+            out.append(self._materialize(reply, ref.id()))
+        return out
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        ids = [r.id().binary() for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            reply = self.conn.request({"type": "check_ready", "object_ids": ids})
+            ready_set = set(reply["ready"])
+            if len(ready_set) >= num_returns or (
+                deadline is not None and time.monotonic() >= deadline
+            ):
+                ready = [r for r in refs if r.id().binary() in ready_set][:num_returns]
+                ready_ids = {r.id().binary() for r in ready}
+                rest = [r for r in refs if r.id().binary() not in ready_ids]
+                return ready, rest
+            pending_ids = [i for i in ids if i not in ready_set]
+            block = None if deadline is None else max(0.0, deadline - time.monotonic())
+            try:
+                self.conn.request(
+                    {"type": "wait_any", "object_ids": pending_ids}, timeout=block
+                )
+            except TimeoutError:
+                pass
+
+    def free(self, refs: Sequence[ObjectRef]):
+        self.conn.send(
+            {"type": "free_objects", "object_ids": [r.id().binary() for r in refs]}
+        )
+
+    # ---------------------------------------------------------------------- kv
+
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True, ns: str = "") -> bool:
+        r = self.conn.request(
+            {"type": "kv_put", "key": key, "value": value, "overwrite": overwrite, "ns": ns}
+        )
+        return r.get("added", False)
+
+    def kv_get(self, key: bytes, ns: str = "") -> Optional[bytes]:
+        return self.conn.request({"type": "kv_get", "key": key, "ns": ns}).get("value")
+
+    def kv_del(self, key: bytes, ns: str = "") -> bool:
+        return self.conn.request({"type": "kv_del", "key": key, "ns": ns}).get("deleted", False)
+
+    def kv_exists(self, key: bytes, ns: str = "") -> bool:
+        return self.conn.request({"type": "kv_exists", "key": key, "ns": ns}).get("exists", False)
+
+    def kv_keys(self, prefix: bytes = b"", ns: str = "") -> List[bytes]:
+        return self.conn.request({"type": "kv_keys", "prefix": prefix, "ns": ns}).get("keys", [])
+
+    # ------------------------------------------------------------------- misc
+
+    def cluster_info(self) -> Dict[str, Any]:
+        return self.conn.request({"type": "cluster_info"})
+
+    def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Dict[str, Any]:
+        return self.conn.request(msg, timeout=timeout)
+
+    def send(self, msg: Dict[str, Any]) -> None:
+        self.conn.send(msg)
+
+    def close(self):
+        self.conn.close()
+        self.store.close()
+
+
+def object_segment_put(store: ObjectStore, oid: ObjectID, payload, buffers, size) -> str:
+    from multiprocessing import shared_memory
+
+    from .object_store import segment_name, _untrack
+
+    name = segment_name(oid)
+    shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+    _untrack(shm)
+    serialization.write_to(shm.buf, payload, buffers)
+    store._segments[name] = shm  # noqa: SLF001 — retain mapping
+    return name

@@ -1,0 +1,79 @@
+"""Runtime configuration, overridable via ``RAY_TPU_<name>`` env vars.
+
+Equivalent of the reference's RAY_CONFIG system
+(reference: src/ray/common/ray_config_def.h — 217 entries, each
+overridable by a RAY_<name> env var, plus a JSON _system_config).
+We keep the same three-layer precedence: default < _system_config dict
+passed to init() < environment variable.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_DEFAULTS: Dict[str, Any] = {
+    # Objects at or below this size are carried inline through the control
+    # plane instead of the shared-memory store (reference:
+    # max_direct_call_object_size, ray_config_def.h).
+    "max_inline_object_size": 100 * 1024,
+    # Chunk size for node-to-node object transfer (reference: 5 MiB,
+    # ray_config_def.h:345).
+    "object_transfer_chunk_bytes": 5 * 1024 * 1024,
+    # Worker pool sizing.
+    "num_prestart_workers": 2,
+    "worker_register_timeout_s": 30.0,
+    "worker_idle_timeout_s": 300.0,
+    # Health checking (reference: gcs_health_check_manager.h).
+    "health_check_period_ms": 1000,
+    "health_check_failure_threshold": 5,
+    # Task scheduling.
+    "max_pending_lease_requests_per_scheduling_class": 10,
+    # Testing hook: inject a delay (us range "min:max") into control-plane
+    # message handling, keyed by message type (reference:
+    # RAY_testing_asio_delay_us, ray_config_def.h:832).
+    "testing_rpc_delay_us": "",
+    # Object store.
+    "object_store_memory_bytes": 0,  # 0 = unlimited (shm-backed)
+    "object_spilling_directory": "",
+    # Metrics.
+    "metrics_report_interval_ms": 1000,
+}
+
+
+class _Config:
+    def __init__(self):
+        self._values = dict(_DEFAULTS)
+
+    def initialize(self, system_config: Dict[str, Any] | None = None):
+        self._values = dict(_DEFAULTS)
+        if system_config:
+            for k, v in system_config.items():
+                if k not in _DEFAULTS:
+                    raise ValueError(f"Unknown system config entry: {k}")
+                self._values[k] = v
+        for k in _DEFAULTS:
+            env = os.environ.get(f"RAY_TPU_{k}")
+            if env is not None:
+                default = _DEFAULTS[k]
+                if isinstance(default, bool):
+                    self._values[k] = env.lower() in ("1", "true", "yes")
+                elif isinstance(default, int):
+                    self._values[k] = int(env)
+                elif isinstance(default, float):
+                    self._values[k] = float(env)
+                else:
+                    self._values[k] = env
+
+    def __getattr__(self, name: str):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def dump(self) -> str:
+        return json.dumps(self._values)
+
+
+RayConfig = _Config()
+RayConfig.initialize()

@@ -1,0 +1,1044 @@
+"""Global control service: the cluster control plane.
+
+Reference: src/ray/gcs/gcs_server/ — GcsServer owns node membership, the
+actor directory + scheduler, jobs, placement groups, internal KV and the
+function table (gcs_server.cc:138,187-232). The reference splits
+scheduling between GCS (actors, PGs) and per-node raylets (task leases,
+local dispatch — raylet/node_manager.h:119, cluster_task_manager.cc:44).
+In this rebuild the single-host control plane folds both roles into one
+authority: the GCS holds the (eventually-multi-node) resource view and
+does lease + dispatch directly, removing the spillback round-trips the
+reference needs because its resource view is only eventually consistent.
+Node abstractions are kept so a multi-node topology (one GCS per cluster,
+N virtual nodes with their own worker pools) runs in one process tree,
+mirroring the reference's Cluster test harness
+(python/ray/cluster_utils.py:135).
+
+Tables owned here:
+  - object directory: id -> (inline bytes | shm segment), waiters
+  - function table: function_id -> cloudpickle blob
+  - actor directory: id -> (worker, state machine PENDING/ALIVE/DEAD)
+  - node table + resource view (total/available per node)
+  - placement groups: bundles reserved against node resources
+  - internal KV
+"""
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from multiprocessing.connection import Listener
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .config import RayConfig
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
+from .object_store import ObjectStore
+from .protocol import ConnectionLost, PeerConn
+from .task_spec import TaskSpec
+
+# Object status
+PENDING, READY, FAILED = "PENDING", "READY", "FAILED"
+# Actor states (reference: src/ray/design_docs/actor_states.rst)
+A_PENDING, A_ALIVE, A_RESTARTING, A_DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+# Worker states
+W_STARTING, W_IDLE, W_BUSY, W_ACTOR, W_DEAD = (
+    "STARTING",
+    "IDLE",
+    "BUSY",
+    "ACTOR",
+    "DEAD",
+)
+
+
+@dataclass
+class ObjectEntry:
+    status: str = PENDING
+    inline: Optional[bytes] = None
+    segment: Optional[str] = None
+    size: int = 0
+    error: Optional[bytes] = None  # serialized exception when FAILED
+    node_id: Optional[NodeID] = None
+    # (peer, req_id) blocked gets to answer on seal.
+    waiters: List[Tuple[PeerConn, int]] = field(default_factory=list)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    node_id: NodeID
+    state: str = W_STARTING
+    conn: Optional[PeerConn] = None
+    proc: Optional[subprocess.Popen] = None
+    pid: int = 0
+    current_task: Optional[TaskSpec] = None
+    actor_id: Optional[ActorID] = None
+    # Dispatched-but-unfinished specs (task_id -> spec); failed on death.
+    inflight: Dict[bytes, TaskSpec] = field(default_factory=dict)
+
+
+@dataclass
+class ActorState:
+    actor_id: ActorID
+    spec: TaskSpec
+    state: str = A_PENDING
+    worker_id: Optional[WorkerID] = None
+    name: Optional[str] = None
+    pending: deque = field(default_factory=deque)  # method specs buffered pre-ALIVE
+    restarts_used: int = 0
+    death_reason: str = ""
+
+
+@dataclass
+class NodeState:
+    node_id: NodeID
+    total: Dict[str, float]
+    available: Dict[str, float]
+    alive: bool = True
+    # Fungible (non-actor) worker ids on this node.
+    pool: Set[bytes] = field(default_factory=set)
+    label: str = ""
+
+
+@dataclass
+class BundleState:
+    resources: Dict[str, float]
+    available: Dict[str, float]
+    node_id: Optional[NodeID] = None
+
+
+@dataclass
+class PlacementGroupState:
+    pg_id: PlacementGroupID
+    bundles: List[BundleState]
+    strategy: str
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    name: str = ""
+    waiters: List[Tuple[PeerConn, int]] = field(default_factory=list)
+
+
+class _Unschedulable(Exception):
+    """Task can never be placed (bad/removed PG); fail instead of requeue."""
+
+
+def _fits(avail: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+
+def _acquire(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _release(avail: Dict[str, float], demand: Dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+class GcsServer:
+    def __init__(self, session_dir: str, address: str, authkey: bytes,
+                 head_resources: Dict[str, float]):
+        self.session_dir = session_dir
+        self.address = address
+        self.authkey = authkey
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+
+        self.objects: Dict[bytes, ObjectEntry] = {}
+        self.functions: Dict[bytes, bytes] = {}
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        self.actors: Dict[bytes, ActorState] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.nodes: Dict[bytes, NodeState] = {}
+        self.placement_groups: Dict[bytes, PlacementGroupState] = {}
+        self._pending: deque[TaskSpec] = deque()
+        self._store = ObjectStore()
+        self._peers: List[PeerConn] = []
+        self._shutdown = False
+        self._worker_counter = 0
+
+        head = NodeState(
+            node_id=NodeID.from_random(),
+            total=dict(head_resources),
+            available=dict(head_resources),
+            label="head",
+        )
+        self.head_node = head
+        self.nodes[head.node_id.binary()] = head
+
+        self._listener = Listener(address, family="AF_UNIX", authkey=authkey)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="gcs-accept", daemon=True
+        )
+        self._sched_thread = threading.Thread(
+            target=self._sched_loop, name="gcs-sched", daemon=True
+        )
+        self._accept_thread.start()
+        self._sched_thread.start()
+        # Prestart a few workers so the first task doesn't pay spawn latency
+        # (reference: worker_pool.cc:1323 PrestartWorkers).
+        with self._lock:
+            for _ in range(
+                min(RayConfig.num_prestart_workers, int(head.total.get("CPU", 1)))
+            ):
+                self._spawn_worker(head)
+
+    # ------------------------------------------------------------------ accept
+
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            state: Dict[str, Any] = {}
+            peer = PeerConn(
+                conn,
+                push_handler=lambda msg, s=state: self._dispatch(s, msg),
+                on_close=lambda s=state: self._on_peer_close(s),
+                name="gcs-peer",
+                autostart=False,
+            )
+            state["peer"] = peer
+            with self._lock:
+                self._peers.append(peer)
+            peer.start()
+
+    def _on_peer_close(self, state: Dict[str, Any]):
+        wid = state.get("worker_id")
+        if wid is not None:
+            self._handle_worker_death(wid, "worker connection closed")
+
+    # ---------------------------------------------------------------- dispatch
+
+    def _dispatch(self, state: Dict[str, Any], msg: Dict[str, Any]):
+        mtype = msg["type"]
+        delay_spec = RayConfig.testing_rpc_delay_us
+        if delay_spec:
+            self._maybe_inject_delay(mtype, delay_spec)
+        handler = getattr(self, f"_h_{mtype}", None)
+        if handler is None:
+            peer: PeerConn = state["peer"]
+            if "req_id" in msg:
+                peer.reply(msg, ok=False, error=f"unknown message type {mtype}")
+            return
+        try:
+            handler(state, msg)
+        except Exception as e:  # noqa: BLE001
+            peer = state["peer"]
+            if "req_id" in msg:
+                try:
+                    peer.reply(msg, ok=False, error=f"{type(e).__name__}: {e}")
+                except ConnectionLost:
+                    pass
+            else:
+                sys.stderr.write(f"gcs: error handling {mtype}: {e}\n")
+
+    @staticmethod
+    def _maybe_inject_delay(mtype: str, spec: str):
+        # "msgtype=min:max,msgtype2=min:max" in microseconds
+        # (reference: RAY_testing_asio_delay_us, ray_config_def.h:832).
+        for entry in spec.split(","):
+            if "=" not in entry:
+                continue
+            name, rng = entry.split("=", 1)
+            if name != mtype and name != "*":
+                continue
+            lo, hi = rng.split(":")
+            time.sleep(random.uniform(float(lo), float(hi)) / 1e6)
+
+    # ---------------------------------------------------------------- handlers
+
+    def _h_hello(self, state, msg):
+        peer: PeerConn = state["peer"]
+        role = msg["role"]
+        state["role"] = role
+        if role == "worker":
+            wid = msg["worker_id"]
+            state["worker_id"] = wid
+            with self._lock:
+                w = self.workers.get(wid)
+                if w is None:
+                    # Externally started worker (tests); adopt onto head node.
+                    w = WorkerHandle(
+                        worker_id=WorkerID(wid), node_id=self.head_node.node_id
+                    )
+                    self.workers[wid] = w
+                    node = self.head_node
+                else:
+                    node = self.nodes[w.node_id.binary()]
+                w.conn = peer
+                w.pid = msg.get("pid", 0)
+                w.state = W_IDLE
+                node.pool.add(wid)
+                self._work.notify_all()
+        peer.reply(msg, ok=True, session_dir=self.session_dir)
+
+    def _h_register_function(self, state, msg):
+        with self._lock:
+            self.functions[msg["function_id"]] = msg["blob"]
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True)
+
+    def _h_get_function(self, state, msg):
+        with self._lock:
+            blob = self.functions.get(msg["function_id"])
+        state["peer"].reply(msg, ok=blob is not None, blob=blob)
+
+    def _h_submit_task(self, state, msg):
+        spec: TaskSpec = msg["spec"]
+        with self._lock:
+            if spec.function_blob is not None:
+                self.functions.setdefault(spec.function_id, spec.function_blob)
+                spec.function_blob = None
+            for oid in spec.return_object_ids():
+                self.objects.setdefault(oid.binary(), ObjectEntry())
+            if spec.actor_id is not None and not spec.actor_creation:
+                self._route_actor_task(spec)
+            else:
+                if spec.actor_creation:
+                    aid = spec.actor_id.binary()
+                    actor = ActorState(
+                        actor_id=spec.actor_id, spec=spec, name=spec.actor_name
+                    )
+                    self.actors[aid] = actor
+                    if spec.actor_name:
+                        if spec.actor_name in self.named_actors:
+                            self._fail_task_returns(
+                                spec,
+                                ValueError(
+                                    f"actor name '{spec.actor_name}' already taken"
+                                ),
+                            )
+                            self.actors.pop(aid, None)
+                            return
+                        self.named_actors[spec.actor_name] = aid
+                self._pending.append(spec)
+                self._work.notify_all()
+
+    def _route_actor_task(self, spec: TaskSpec):
+        """Dispatch an actor method to its pinned worker (ordered FIFO)."""
+        aid = spec.actor_id.binary()
+        actor = self.actors.get(aid)
+        if actor is None or actor.state == A_DEAD:
+            reason = actor.death_reason if actor else "actor not found"
+            self._fail_task_returns(spec, None, actor_error=reason)
+            return
+        if actor.state in (A_PENDING, A_RESTARTING):
+            actor.pending.append(spec)
+            return
+        w = self.workers[actor.worker_id.binary()]
+        w.inflight[spec.task_id.binary()] = spec
+        try:
+            w.conn.send({"type": "execute_task", "spec": spec})
+        except ConnectionLost:
+            w.inflight.pop(spec.task_id.binary(), None)
+            actor.pending.append(spec)
+
+    def _h_task_done(self, state, msg):
+        wid = msg["worker_id"]
+        results = msg["results"]  # list of dicts per return
+        error_blob = msg.get("error")
+        with self._lock:
+            w = self.workers.get(wid)
+            task_id = msg["task_id"]
+            spec: Optional[TaskSpec] = w.inflight.get(task_id) if w else None
+            if w is not None:
+                w.inflight.pop(task_id, None)
+                if w.state == W_BUSY:
+                    w.state = W_ACTOR if w.actor_id is not None else W_IDLE
+                    if w.current_task is not None:
+                        # Actors hold their creation resources for their
+                        # lifetime (released on death), unless creation failed.
+                        if not w.current_task.actor_creation or error_blob is not None:
+                            self._release_task_resources(w.current_task, w.node_id)
+                    w.current_task = None
+            # Application-level retry (reference: TaskManager::RetryTaskIfPossible
+            # task_manager.h:468 — app errors retry only with retry_exceptions).
+            if (
+                error_blob is not None
+                and spec is not None
+                and not spec.actor_creation
+                and spec.actor_id is None
+                and spec.retry_exceptions
+                and spec.max_retries > 0
+            ):
+                spec.max_retries -= 1
+                self._pending.append(spec)
+                self._work.notify_all()
+                return
+            for r in results:
+                entry = self.objects.setdefault(r["object_id"], ObjectEntry())
+                if error_blob is not None:
+                    entry.status = FAILED
+                    entry.error = error_blob
+                else:
+                    entry.status = READY
+                    entry.inline = r.get("inline")
+                    entry.segment = r.get("segment")
+                    entry.size = r.get("size", 0)
+                    entry.node_id = w.node_id if w else None
+                self._notify_object(entry)
+            if msg.get("actor_creation"):
+                self._on_actor_created(msg["actor_id"], wid, ok=error_blob is None,
+                                       error_blob=error_blob)
+            self._work.notify_all()
+
+    def _on_actor_created(self, aid: bytes, wid: bytes, ok: bool, error_blob=None):
+        actor = self.actors.get(aid)
+        if actor is None:
+            return
+        w = self.workers.get(wid)
+        if ok:
+            actor.state = A_ALIVE
+            actor.worker_id = WorkerID(wid)
+            if w is not None:
+                w.state = W_ACTOR
+                w.actor_id = actor.actor_id
+                node = self.nodes[w.node_id.binary()]
+                node.pool.discard(wid)  # no longer fungible
+            while actor.pending:
+                self._route_actor_task(actor.pending.popleft())
+        else:
+            actor.state = A_DEAD
+            actor.death_reason = "creation task failed"
+            if actor.name:
+                self.named_actors.pop(actor.name, None)
+            while actor.pending:
+                self._fail_task_returns(
+                    actor.pending.popleft(), None, actor_error=actor.death_reason
+                )
+            # The worker that failed construction is pinned but useless; let
+            # it exit rather than leak one process per failed creation.
+            if w is not None and w.state != W_DEAD:
+                w.state = W_DEAD
+                if w.conn is not None:
+                    try:
+                        w.conn.send({"type": "exit"})
+                    except ConnectionLost:
+                        pass
+                if w.proc is not None:
+                    threading.Thread(target=_reap, args=(w.proc,), daemon=True).start()
+
+    def _h_put_object(self, state, msg):
+        with self._lock:
+            entry = self.objects.setdefault(msg["object_id"], ObjectEntry())
+            entry.status = READY
+            entry.inline = msg.get("inline")
+            entry.segment = msg.get("segment")
+            entry.size = msg.get("size", 0)
+            self._notify_object(entry)
+        state["peer"].reply(msg, ok=True)
+
+    def _object_reply_fields(self, entry: ObjectEntry) -> Dict[str, Any]:
+        if entry.status == FAILED:
+            return {"ok": True, "status": FAILED, "error": entry.error}
+        return {
+            "ok": True,
+            "status": READY,
+            "inline": entry.inline,
+            "segment": entry.segment,
+            "size": entry.size,
+        }
+
+    def _notify_object(self, entry: ObjectEntry):
+        waiters, entry.waiters = entry.waiters, []
+        fields = self._object_reply_fields(entry)
+        for peer, req_id in waiters:
+            try:
+                peer.send({"type": "reply", "req_id": req_id, **fields})
+            except ConnectionLost:
+                pass
+
+    def _h_get_object(self, state, msg):
+        peer: PeerConn = state["peer"]
+        with self._lock:
+            entry = self.objects.setdefault(msg["object_id"], ObjectEntry())
+            if entry.status == PENDING:
+                entry.waiters.append((peer, msg["req_id"]))
+                return
+            fields = self._object_reply_fields(entry)
+        peer.reply(msg, **fields)
+
+    def _h_check_ready(self, state, msg):
+        with self._lock:
+            ready = [
+                oid
+                for oid in msg["object_ids"]
+                if self.objects.get(oid) is not None
+                and self.objects[oid].status != PENDING
+            ]
+        state["peer"].reply(msg, ok=True, ready=ready)
+
+    def _h_wait_any(self, state, msg):
+        """Block until any of object_ids is sealed (client enforces timeout)."""
+        peer: PeerConn = state["peer"]
+        with self._lock:
+            for oid in msg["object_ids"]:
+                entry = self.objects.setdefault(oid, ObjectEntry())
+                if entry.status != PENDING:
+                    peer.reply(msg, ok=True)
+                    return
+            for oid in msg["object_ids"]:
+                self.objects[oid].waiters.append((peer, msg["req_id"]))
+
+    def _h_free_objects(self, state, msg):
+        with self._lock:
+            for oid in msg["object_ids"]:
+                entry = self.objects.pop(oid, None)
+                if entry is not None and entry.segment:
+                    self._store.delete(ObjectID(oid))
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True)
+
+    # KV (reference: gcs_kv_manager.cc; python facade experimental/internal_kv.py)
+    def _h_kv_put(self, state, msg):
+        ns = self.kv.setdefault(msg.get("ns", ""), {})
+        with self._lock:
+            existed = msg["key"] in ns
+            if not existed or msg.get("overwrite", True):
+                ns[msg["key"]] = msg["value"]
+        state["peer"].reply(msg, ok=True, added=not existed)
+
+    def _h_kv_get(self, state, msg):
+        with self._lock:
+            val = self.kv.get(msg.get("ns", ""), {}).get(msg["key"])
+        state["peer"].reply(msg, ok=True, value=val)
+
+    def _h_kv_del(self, state, msg):
+        with self._lock:
+            existed = self.kv.get(msg.get("ns", ""), {}).pop(msg["key"], None)
+        state["peer"].reply(msg, ok=True, deleted=existed is not None)
+
+    def _h_kv_exists(self, state, msg):
+        with self._lock:
+            exists = msg["key"] in self.kv.get(msg.get("ns", ""), {})
+        state["peer"].reply(msg, ok=True, exists=exists)
+
+    def _h_kv_keys(self, state, msg):
+        with self._lock:
+            keys = [
+                k
+                for k in self.kv.get(msg.get("ns", ""), {})
+                if k.startswith(msg.get("prefix", b""))
+            ]
+        state["peer"].reply(msg, ok=True, keys=keys)
+
+    def _h_get_actor(self, state, msg):
+        with self._lock:
+            aid = msg.get("actor_id")
+            if aid is None:
+                aid = self.named_actors.get(msg["name"])
+            actor = self.actors.get(aid) if aid else None
+            if actor is None:
+                state["peer"].reply(msg, ok=False, error="actor not found")
+                return
+            state["peer"].reply(
+                msg,
+                ok=True,
+                actor_id=actor.actor_id.binary(),
+                state=actor.state,
+                spec_function_id=actor.spec.function_id,
+                max_concurrency=actor.spec.max_concurrency,
+            )
+
+    def _h_kill_actor(self, state, msg):
+        with self._lock:
+            self._kill_actor(msg["actor_id"], reason=msg.get("reason", "ray.kill"))
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True)
+
+    def _kill_actor(self, aid: bytes, reason: str):
+        actor = self.actors.get(aid)
+        if actor is None or actor.state == A_DEAD:
+            return
+        actor.state = A_DEAD
+        actor.death_reason = reason
+        if actor.name:
+            self.named_actors.pop(actor.name, None)
+        while actor.pending:
+            self._fail_task_returns(actor.pending.popleft(), None, actor_error=reason)
+        if actor.worker_id is not None:
+            w = self.workers.get(actor.worker_id.binary())
+            if w is not None and w.state != W_DEAD:
+                w.state = W_DEAD
+                self._release_task_resources(actor.spec, w.node_id)
+                if w.conn is not None:
+                    try:
+                        w.conn.send({"type": "exit"})
+                    except ConnectionLost:
+                        pass
+                if w.proc is not None:
+                    threading.Thread(
+                        target=_reap, args=(w.proc,), daemon=True
+                    ).start()
+
+    def _h_actor_exit(self, state, msg):
+        # Graceful self-exit (__ray_terminate__).
+        with self._lock:
+            self._kill_actor(msg["actor_id"], reason="actor exited")
+
+    def _h_cluster_info(self, state, msg):
+        with self._lock:
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            nodes = []
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.total.items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in n.available.items():
+                    avail[k] = avail.get(k, 0.0) + v
+                nodes.append(
+                    {
+                        "node_id": n.node_id.binary(),
+                        "label": n.label,
+                        "alive": n.alive,
+                        "total": dict(n.total),
+                        "available": dict(n.available),
+                    }
+                )
+        state["peer"].reply(msg, ok=True, total=total, available=avail, nodes=nodes)
+
+    def _h_ping(self, state, msg):
+        state["peer"].reply(msg, ok=True, ts=time.time())
+
+    # ------------------------------------------------------- placement groups
+
+    def _h_create_placement_group(self, state, msg):
+        peer = state["peer"]
+        with self._lock:
+            pg = PlacementGroupState(
+                pg_id=PlacementGroupID(msg["pg_id"]),
+                bundles=[
+                    BundleState(resources=dict(b), available=dict(b))
+                    for b in msg["bundles"]
+                ],
+                strategy=msg["strategy"],
+                name=msg.get("name", ""),
+            )
+            ok, err = self._try_reserve_pg(pg)
+            if not ok:
+                peer.reply(msg, ok=False, error=err)
+                return
+            pg.state = "CREATED"
+            self.placement_groups[pg.pg_id.binary()] = pg
+        peer.reply(msg, ok=True)
+
+    def _try_reserve_pg(self, pg: PlacementGroupState) -> Tuple[bool, str]:
+        """Reserve all bundles atomically (the reference needs 2PC across
+        raylets — gcs_placement_group_scheduler.h:113; with the resource
+        authority centralized here, reserve-all-or-nothing is one
+        transaction under the table lock)."""
+        nodes = [n for n in self.nodes.values() if n.alive]
+        placement: List[Tuple[BundleState, NodeState]] = []
+        scratch = {n.node_id.binary(): dict(n.available) for n in nodes}
+        strategy = pg.strategy
+
+        def try_place(bundle: BundleState, candidates: List[NodeState]) -> bool:
+            for n in candidates:
+                if _fits(scratch[n.node_id.binary()], bundle.resources):
+                    _acquire(scratch[n.node_id.binary()], bundle.resources)
+                    placement.append((bundle, n))
+                    return True
+            return False
+
+        if strategy in ("PACK", "STRICT_PACK"):
+            # Fill one node first; STRICT_PACK fails if one node can't hold all.
+            for bundle in pg.bundles:
+                order = sorted(
+                    nodes,
+                    key=lambda n: -sum(
+                        1 for b, pn in placement if pn.node_id == n.node_id
+                    ),
+                )
+                if strategy == "STRICT_PACK" and placement:
+                    order = [placement[0][1]]
+                if not try_place(bundle, order):
+                    return False, f"cannot place bundle {bundle.resources} ({strategy})"
+        elif strategy in ("SPREAD", "STRICT_SPREAD"):
+            for bundle in pg.bundles:
+                used = {pn.node_id.binary() for b, pn in placement}
+                fresh = [n for n in nodes if n.node_id.binary() not in used]
+                candidates = fresh if strategy == "STRICT_SPREAD" else fresh + [
+                    n for n in nodes if n.node_id.binary() in used
+                ]
+                if not try_place(bundle, candidates):
+                    return False, f"cannot place bundle {bundle.resources} ({strategy})"
+        else:
+            return False, f"unknown strategy {strategy}"
+
+        for bundle, node in placement:
+            _acquire(node.available, bundle.resources)
+            bundle.node_id = node.node_id
+        return True, ""
+
+    def _h_remove_placement_group(self, state, msg):
+        with self._lock:
+            pg = self.placement_groups.pop(msg["pg_id"], None)
+            if pg is not None:
+                for bundle in pg.bundles:
+                    if bundle.node_id is not None:
+                        node = self.nodes.get(bundle.node_id.binary())
+                        if node is not None:
+                            # Return only the bundle's free headroom now;
+                            # resources held by still-running tasks flow back
+                            # to the node when those tasks finish (the PG is
+                            # gone, so _release_task_resources falls through
+                            # to the node pool).
+                            _release(node.available, bundle.available)
+                pg.state = "REMOVED"
+            self._work.notify_all()
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True)
+
+    def _h_placement_group_info(self, state, msg):
+        with self._lock:
+            pg = self.placement_groups.get(msg["pg_id"])
+            if pg is None:
+                state["peer"].reply(msg, ok=False, error="placement group not found")
+                return
+            state["peer"].reply(
+                msg,
+                ok=True,
+                state=pg.state,
+                bundles=[
+                    {
+                        "resources": dict(b.resources),
+                        "available": dict(b.available),
+                        "node_id": b.node_id.binary() if b.node_id else None,
+                    }
+                    for b in pg.bundles
+                ],
+            )
+
+    # ------------------------------------------------------------- node admin
+
+    def _h_add_node(self, state, msg):
+        with self._lock:
+            node = NodeState(
+                node_id=NodeID.from_random(),
+                total=dict(msg["resources"]),
+                available=dict(msg["resources"]),
+                label=msg.get("label", ""),
+            )
+            self.nodes[node.node_id.binary()] = node
+            self._work.notify_all()
+        state["peer"].reply(msg, ok=True, node_id=node.node_id.binary())
+
+    def _h_remove_node(self, state, msg):
+        with self._lock:
+            node = self.nodes.get(msg["node_id"])
+            if node is None:
+                state["peer"].reply(msg, ok=False, error="no such node")
+                return
+            node.alive = False
+            dead_workers = [
+                w for w in self.workers.values() if w.node_id.binary() == msg["node_id"]
+            ]
+        for w in dead_workers:
+            if w.proc is not None:
+                w.proc.terminate()
+            self._handle_worker_death(
+                w.worker_id.binary(), "node removed", respawn=False
+            )
+        state["peer"].reply(msg, ok=True)
+
+    # ------------------------------------------------------------- scheduling
+
+    def _fail_task_returns(self, spec: TaskSpec, exc: Optional[BaseException],
+                           actor_error: Optional[str] = None,
+                           error_blob: Optional[bytes] = None):
+        from . import serialization
+        from ..exceptions import ActorDiedError, RayTaskError
+
+        if error_blob is None:
+            if actor_error is not None:
+                exc = ActorDiedError(
+                    spec.actor_id.hex() if spec.actor_id else None, actor_error
+                )
+            if not isinstance(exc, RayTaskError):
+                exc = RayTaskError.from_exception(spec.name, exc)
+            error_blob = serialization.pack(exc)
+        for oid in spec.return_object_ids():
+            entry = self.objects.setdefault(oid.binary(), ObjectEntry())
+            entry.status = FAILED
+            entry.error = error_blob
+            self._notify_object(entry)
+
+    def _deps_ready(self, spec: TaskSpec) -> bool:
+        return all(
+            (e := self.objects.get(d.binary())) is not None and e.status != PENDING
+            for d in spec.dependencies
+        )
+
+    def _task_resources(self, spec: TaskSpec) -> Dict[str, float]:
+        return {k: v for k, v in spec.resources.items() if v > 0}
+
+    def _release_task_resources(self, spec: TaskSpec, node_id: NodeID):
+        res = self._task_resources(spec)
+        if not res:
+            return
+        node = self.nodes.get(node_id.binary())
+        if spec.placement_group_id is not None:
+            pg = self.placement_groups.get(spec.placement_group_id.binary())
+            if pg is not None and 0 <= spec.placement_group_bundle_index < len(
+                pg.bundles
+            ):
+                _release(pg.bundles[spec.placement_group_bundle_index].available, res)
+                return
+        if node is not None:
+            _release(node.available, res)
+
+    def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
+        """Hybrid-policy stand-in: prefer nodes with available resources,
+        break ties toward emptier nodes (reference:
+        raylet/scheduling/policy/hybrid_scheduling_policy.h:29-49).
+
+        Raises _Unschedulable for permanently-unplaceable tasks (bad or
+        removed placement group) so the caller fails them instead of
+        requeueing forever."""
+        res = self._task_resources(spec)
+        if spec.placement_group_id is not None:
+            pg = self.placement_groups.get(spec.placement_group_id.binary())
+            if pg is None or pg.state != "CREATED":
+                raise _Unschedulable("placement group removed or not found")
+            idx = spec.placement_group_bundle_index
+            if idx >= len(pg.bundles):
+                raise _Unschedulable(
+                    f"bundle index {idx} out of range for "
+                    f"{len(pg.bundles)}-bundle placement group"
+                )
+            bundles = pg.bundles if idx < 0 else [pg.bundles[idx]]
+            for i, bundle in enumerate(bundles):
+                if _fits(bundle.available, res):
+                    spec.placement_group_bundle_index = idx if idx >= 0 else i
+                    _acquire(bundle.available, res)
+                    return self.nodes.get(bundle.node_id.binary())
+            return None
+        candidates = [
+            n for n in self.nodes.values() if n.alive and _fits(n.available, res)
+        ]
+        if not candidates:
+            return None
+        node = max(
+            candidates,
+            key=lambda n: sum(n.available.get(k, 0.0) for k in ("CPU", "TPU")),
+        )
+        _acquire(node.available, res)
+        return node
+
+    def _sched_loop(self):
+        while True:
+            with self._work:
+                if self._shutdown:
+                    return
+                try:
+                    progressed = self._schedule_once()
+                except Exception as e:  # noqa: BLE001 — scheduler must survive
+                    sys.stderr.write(f"gcs: scheduler error: {e!r}\n")
+                    progressed = False
+                if not progressed:
+                    self._work.wait(timeout=0.2)
+
+    def _schedule_once(self) -> bool:
+        """One scheduling pass under the lock; returns True if anything moved."""
+        progressed = False
+        requeue: List[TaskSpec] = []
+        # Each task that found resources but no worker claims one starting
+        # worker; we only spawn when claims exceed workers already starting
+        # (reference: worker_pool.cc PopWorker -> StartWorkerProcess).
+        claims: Dict[bytes, int] = {}
+        while self._pending:
+            spec = self._pending.popleft()
+            if not self._deps_ready(spec):
+                requeue.append(spec)
+                continue
+            try:
+                node = self._pick_node(spec)
+            except _Unschedulable as e:
+                from ..exceptions import PlacementGroupSchedulingError
+
+                self._fail_task_returns(spec, PlacementGroupSchedulingError(str(e)))
+                progressed = True
+                continue
+            if node is None:
+                requeue.append(spec)
+                continue
+            worker = self._pick_worker(node, spec)
+            if worker is None:
+                # resources were acquired in _pick_node; give them back and
+                # retry once a worker registers.
+                self._release_task_resources(spec, node.node_id)
+                requeue.append(spec)
+                nid = node.node_id.binary()
+                claims[nid] = claims.get(nid, 0) + 1
+                starting = sum(
+                    1
+                    for w in self.workers.values()
+                    if w.node_id.binary() == nid and w.state == W_STARTING
+                )
+                can_grow = spec.actor_creation or (
+                    len(node.pool) + starting < max(int(node.total.get("CPU", 1)), 1)
+                )
+                if starting < claims[nid] and can_grow:
+                    self._spawn_worker(node)
+                continue
+            worker.state = W_BUSY
+            worker.current_task = spec
+            worker.inflight[spec.task_id.binary()] = spec
+            if spec.actor_creation:
+                worker.actor_id = spec.actor_id
+            try:
+                worker.conn.send({"type": "execute_task", "spec": spec})
+                progressed = True
+            except ConnectionLost:
+                self._release_task_resources(spec, node.node_id)
+                requeue.append(spec)
+                self._handle_worker_death(
+                    worker.worker_id.binary(), "send failed", respawn=True
+                )
+        self._pending.extend(requeue)
+        return progressed
+
+    def _pick_worker(self, node: NodeState, spec: TaskSpec) -> Optional[WorkerHandle]:
+        for wid in list(node.pool):
+            w = self.workers.get(wid)
+            if w is not None and w.state == W_IDLE and w.conn is not None:
+                if spec.actor_creation:
+                    node.pool.discard(wid)
+                return w
+        return None
+
+    def _spawn_worker(self, node: NodeState) -> WorkerHandle:
+        self._worker_counter += 1
+        wid = WorkerID.from_random()
+        w = WorkerHandle(worker_id=wid, node_id=node.node_id)
+        self.workers[wid.binary()] = w
+        env = dict(os.environ)
+        env["RAY_TPU_SESSION_ADDR"] = self.address
+        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
+        env["RAY_TPU_WORKER_ID"] = wid.hex()
+        env.setdefault("PYTHONPATH", "")
+        env["PYTHONPATH"] = (
+            os.getcwd() + os.pathsep + sys.path[0] + os.pathsep + env["PYTHONPATH"]
+        )
+        logdir = os.path.join(self.session_dir, "logs")
+        os.makedirs(logdir, exist_ok=True)
+        out = open(os.path.join(logdir, f"worker-{wid.hex()[:8]}.out"), "ab")
+        w.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+        )
+        out.close()
+        return w
+
+    def _handle_worker_death(self, wid: bytes, reason: str, respawn: bool = False):
+        from ..exceptions import WorkerCrashedError
+
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None or w.state == W_DEAD:
+                return
+            prev_state = w.state
+            w.state = W_DEAD
+            node = self.nodes.get(w.node_id.binary())
+            if node is not None:
+                node.pool.discard(wid)
+            if w.current_task is not None:
+                self._release_task_resources(w.current_task, w.node_id)
+                w.current_task = None
+            inflight, w.inflight = dict(w.inflight), {}
+            for spec in inflight.values():
+                if spec.actor_id is not None and not spec.actor_creation:
+                    self._fail_task_returns(
+                        spec, None, actor_error=f"actor worker died: {reason}"
+                    )
+                elif spec.max_retries > 0 and not spec.actor_creation:
+                    # System failures are always retriable up to max_retries
+                    # (reference: task_manager.h RetryTaskIfPossible).
+                    spec.max_retries -= 1
+                    self._pending.append(spec)
+                else:
+                    self._fail_task_returns(
+                        spec, WorkerCrashedError(f"worker died: {reason}")
+                    )
+            if w.actor_id is not None:
+                actor = self.actors.get(w.actor_id.binary())
+                if actor is not None and actor.state not in (A_DEAD, A_RESTARTING):
+                    if prev_state == W_ACTOR:
+                        # Lifetime resources held since creation.
+                        self._release_task_resources(actor.spec, w.node_id)
+                    if actor.restarts_used < actor.spec.max_restarts:
+                        # Restart state machine (reference: GcsActorManager,
+                        # design doc actor_states.rst ALIVE -> RESTARTING).
+                        actor.restarts_used += 1
+                        actor.state = A_RESTARTING
+                        actor.worker_id = None
+                        self._pending.append(actor.spec)
+                    else:
+                        actor.state = A_DEAD
+                        actor.death_reason = f"actor worker died: {reason}"
+                        if actor.name:
+                            self.named_actors.pop(actor.name, None)
+                        while actor.pending:
+                            self._fail_task_returns(
+                                actor.pending.popleft(), None,
+                                actor_error=actor.death_reason,
+                            )
+            self._work.notify_all()
+        if w.proc is not None:
+            threading.Thread(target=_reap, args=(w.proc,), daemon=True).start()
+
+    # --------------------------------------------------------------- shutdown
+
+    def shutdown(self):
+        with self._lock:
+            self._shutdown = True
+            self._work.notify_all()
+            workers = list(self.workers.values())
+            peers = list(self._peers)
+            segs = [
+                ObjectID(oid)
+                for oid, e in self.objects.items()
+                if e.segment is not None
+            ]
+        for w in workers:
+            if w.conn is not None:
+                try:
+                    w.conn.send({"type": "exit"})
+                except ConnectionLost:
+                    pass
+        deadline = time.time() + 2.0
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=max(0.0, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+                    w.proc.wait()
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        for p in peers:
+            p.close()
+        for oid in segs:
+            self._store.delete(oid)
+        self._store.close()
+
+
+def _reap(proc: subprocess.Popen):
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()

@@ -1,0 +1,79 @@
+"""Head-node bring-up: session directory, GCS, resource detection.
+
+Reference: python/ray/_private/node.py — Node starts GCS + raylet +
+agents as subprocesses (start_head_processes :1342). Here the control
+plane runs as threads in the driver process and workers are the only
+subprocesses; the Node owns the session dir and shutdown.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import secrets
+import shutil
+import tempfile
+import time
+from typing import Dict, Optional
+
+from .gcs import GcsServer
+
+
+def detect_num_tpu_chips() -> int:
+    """TPU chip detection (reference: _private/accelerators/tpu.py:98-117 —
+    /dev/accel* for GCE, /dev/vfio for GKE; env override first)."""
+    env = os.environ.get("RAY_TPU_NUM_CHIPS")
+    if env is not None:
+        return int(env)
+    chips = glob.glob("/dev/accel*")
+    if chips:
+        return len(chips)
+    try:
+        vfio = glob.glob("/dev/vfio/*")
+        chips = [p for p in vfio if os.path.basename(p).isdigit()]
+        if chips:
+            return len(chips)
+    except OSError:
+        pass
+    return 0
+
+
+def default_resources(
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+) -> Dict[str, float]:
+    out: Dict[str, float] = {
+        "CPU": float(num_cpus if num_cpus is not None else os.cpu_count() or 1),
+    }
+    tpus = num_tpus if num_tpus is not None else detect_num_tpu_chips()
+    if tpus:
+        out["TPU"] = float(tpus)
+    if resources:
+        out.update({k: float(v) for k, v in resources.items()})
+    return out
+
+
+class Node:
+    """Head node: owns the session and the in-process GCS."""
+
+    def __init__(self, resources: Dict[str, float], temp_dir: Optional[str] = None):
+        base = temp_dir or os.path.join(tempfile.gettempdir(), "ray_tpu")
+        os.makedirs(base, exist_ok=True)
+        self.session_dir = os.path.join(
+            base, f"session_{int(time.time())}_{os.getpid()}_{secrets.token_hex(4)}"
+        )
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        # AF_UNIX socket paths are length-limited (~107 bytes); keep it short.
+        self.address = os.path.join(self.session_dir, "gcs.sock")
+        self.authkey = secrets.token_bytes(16)
+        self.gcs = GcsServer(
+            session_dir=self.session_dir,
+            address=self.address,
+            authkey=self.authkey,
+            head_resources=resources,
+        )
+
+    def shutdown(self, cleanup_session: bool = True):
+        self.gcs.shutdown()
+        if cleanup_session:
+            shutil.rmtree(self.session_dir, ignore_errors=True)

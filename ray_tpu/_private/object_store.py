@@ -1,0 +1,139 @@
+"""Shared-memory object store (plasma equivalent).
+
+The reference embeds a plasma store in the raylet: mmap arenas + dlmalloc,
+a unix-socket flatbuffers protocol, and fd passing (reference:
+src/ray/object_manager/plasma/store.h, plasma_allocator.h, fling.cc).
+On one host we get the same zero-copy property directly from POSIX shared
+memory: each sealed object is one named shm segment; any process on the
+node maps it read-only and deserializes with zero-copy memoryviews over
+the mapping. Naming is content-addressed by ObjectID so there is no fd
+passing or allocation protocol to speak — the control plane only carries
+(object_id, segment_name, size) metadata.
+
+Objects are immutable once sealed, matching plasma semantics.
+"""
+from __future__ import annotations
+
+import threading
+from multiprocessing import shared_memory, resource_tracker
+from typing import Any, Dict, Optional, Tuple
+
+from . import serialization
+from .ids import ObjectID
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    # The per-process resource tracker would unlink segments when *any*
+    # process exits and warn about "leaks"; lifetime is owned by the
+    # session (GCS frees segments on ref-count zero / shutdown) instead.
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+def segment_name(object_id: ObjectID) -> str:
+    return "rtpu_" + object_id.hex()
+
+
+class ObjectStore:
+    """Node-local store of sealed shm objects; one instance per process.
+
+    Keeps mappings of segments this process has created or read. Values
+    returned by ``get`` hold zero-copy views into the mapping; the mapping
+    is retained in ``_segments`` until ``release``d.
+    """
+
+    def __init__(self):
+        self._segments: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def put(self, object_id: ObjectID, value: Any) -> Tuple[str, int]:
+        """Serialize and seal a value; returns (segment_name, size)."""
+        value = serialization.prepare_value(value)
+        payload, buffers = serialization.dumps(value)
+        size = serialization.serialized_size(payload, buffers)
+        name = segment_name(object_id)
+        shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        _untrack(shm)
+        serialization.write_to(shm.buf, payload, buffers)
+        with self._lock:
+            self._segments[name] = shm
+        return name, size
+
+    def get(self, object_id: ObjectID) -> Any:
+        """Map and deserialize a sealed object (zero-copy buffers)."""
+        name = segment_name(object_id)
+        with self._lock:
+            shm = self._segments.get(name)
+            if shm is None:
+                shm = shared_memory.SharedMemory(name=name)
+                _untrack(shm)
+                self._segments[name] = shm
+        return serialization.unpack(shm.buf)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        name = segment_name(object_id)
+        with self._lock:
+            if name in self._segments:
+                return True
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            _untrack(shm)
+            with self._lock:
+                self._segments[name] = shm
+            return True
+        except FileNotFoundError:
+            return False
+
+    def release(self, object_id: ObjectID) -> None:
+        """Drop this process's mapping (does not delete the segment)."""
+        with self._lock:
+            shm = self._segments.pop(segment_name(object_id), None)
+        if shm is not None:
+            try:
+                shm.close()
+            except BufferError:
+                # Zero-copy views into the mapping are still alive somewhere;
+                # keep the mapping rather than invalidate them.
+                with self._lock:
+                    self._segments[segment_name(object_id)] = shm
+
+    def delete(self, object_id: ObjectID) -> None:
+        """Unlink the segment from the node (owner/GCS-driven)."""
+        name = segment_name(object_id)
+        with self._lock:
+            shm = self._segments.pop(name, None)
+        if shm is None:
+            try:
+                shm = shared_memory.SharedMemory(name=name)
+                _untrack(shm)
+            except FileNotFoundError:
+                return
+        try:
+            # unlink() also unregisters with the resource tracker; re-register
+            # first so the pair balances (we unregistered at create/attach).
+            resource_tracker.register(shm._name, "shared_memory")  # noqa: SLF001
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:
+            pass
+        try:
+            shm.close()
+        except BufferError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            segs = list(self._segments.values())
+            self._segments.clear()
+        for shm in segs:
+            try:
+                shm.close()
+            except BufferError:
+                # Zero-copy views still alive; leave the mapping to die with
+                # the process and silence __del__'s close() retry.
+                shm.close = lambda: None
+            except Exception:
+                pass

@@ -1,0 +1,115 @@
+"""Control-plane message transport.
+
+The reference's control plane is gRPC (src/ray/rpc/) with one service per
+daemon. On-node we use unix-domain sockets via multiprocessing.connection
+(length-prefixed pickle frames) — the same request/reply + push pattern,
+without a schema compiler. A ``PeerConn`` wraps a Connection with a send
+lock, a reader thread, request/reply correlation futures, and a handler
+for unsolicited pushes (the pubsub direction).
+
+Message = dict with a "type" key. Replies carry the originating "req_id".
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import Future
+from multiprocessing.connection import Connection
+from typing import Any, Callable, Dict, Optional
+
+
+class ConnectionLost(Exception):
+    pass
+
+
+class PeerConn:
+    """Bidirectional framed channel with request/reply correlation."""
+
+    def __init__(
+        self,
+        conn: Connection,
+        push_handler: Callable[[Dict[str, Any]], None],
+        on_close: Optional[Callable[[], None]] = None,
+        name: str = "peer",
+        autostart: bool = True,
+    ):
+        self._conn = conn
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._req_counter = itertools.count()
+        self._push_handler = push_handler
+        self._on_close = on_close
+        self._closed = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"reader-{name}", daemon=True
+        )
+        if autostart:
+            self._reader.start()
+
+    def start(self) -> None:
+        """Start the reader (for callers that must finish wiring first)."""
+        if not self._reader.is_alive():
+            self._reader.start()
+
+    def send(self, msg: Dict[str, Any]) -> None:
+        """Fire-and-forget push."""
+        with self._send_lock:
+            try:
+                self._conn.send(msg)
+            except (OSError, EOFError, BrokenPipeError) as e:
+                raise ConnectionLost(str(e)) from e
+
+    def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
+        """Send and block for the correlated reply; returns reply dict."""
+        req_id = next(self._req_counter)
+        msg = dict(msg, req_id=req_id)
+        fut: Future = Future()
+        with self._pending_lock:
+            self._pending[req_id] = fut
+        try:
+            self.send(msg)
+            return fut.result(timeout=timeout)
+        finally:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+
+    def reply(self, req_msg: Dict[str, Any], **fields) -> None:
+        self.send({"type": "reply", "req_id": req_msg["req_id"], **fields})
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = self._conn.recv()
+                if msg.get("type") == "reply":
+                    with self._pending_lock:
+                        fut = self._pending.pop(msg["req_id"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+                else:
+                    self._push_handler(msg)
+        except (EOFError, OSError, BrokenPipeError):
+            pass
+        finally:
+            self._closed.set()
+            with self._pending_lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for fut in pending:
+                if not fut.done():
+                    fut.set_exception(ConnectionLost("peer connection closed"))
+            if self._on_close is not None:
+                try:
+                    self._on_close()
+                except Exception:
+                    pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:
+            pass

@@ -1,0 +1,116 @@
+"""Value serialization for the object store and control plane.
+
+The reference uses a msgpack + pickle5 protocol with out-of-band buffers
+(reference: python/ray/_private/serialization.py) so large numpy / arrow
+buffers travel zero-copy through plasma. We keep the same shape: values
+are cloudpickle-serialized with pickle protocol 5, out-of-band buffers are
+concatenated after a small header so a reader can reconstruct them as
+memoryviews over shared memory without copying.
+
+Layout of a serialized value:
+
+    [8s magic][u32 pickle_len][u32 nbuffers][u64 buffer_len]*n
+    [pickle bytes][buffer bytes]*n  (each buffer 64-byte aligned)
+
+jax.Array values are converted to numpy on put (device -> host) and
+restored as numpy; consumers move them back on-device with device_put.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+MAGIC = b"RTPUOBJ1"
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def dumps(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Serialize to (header+pickle bytes, out-of-band buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    return payload, buffers
+
+
+def serialized_size(payload: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    header = 8 + 4 + 4 + 8 * len(buffers)
+    size = _align(header + len(payload))
+    for b in buffers:
+        size += _align(len(b.raw()))
+    return size
+
+
+def write_to(view: memoryview, payload: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    """Write the serialized value into a writable memoryview; returns bytes used."""
+    header = struct.pack(
+        f"<8sII{len(buffers)}Q",
+        MAGIC,
+        len(payload),
+        len(buffers),
+        *[len(b.raw()) for b in buffers],
+    )
+    off = 0
+    view[off : off + len(header)] = header
+    off += len(header)
+    view[off : off + len(payload)] = payload
+    off = _align(off + len(payload))
+    for b in buffers:
+        raw = b.raw()
+        n = len(raw)
+        view[off : off + n] = raw
+        off = _align(off + n)
+    return off
+
+
+def pack(value: Any) -> bytes:
+    """Serialize into one contiguous bytes object (for inline objects)."""
+    payload, buffers = dumps(value)
+    size = serialized_size(payload, buffers)
+    buf = bytearray(size)
+    write_to(memoryview(buf), payload, buffers)
+    return bytes(buf)
+
+
+def unpack(view: memoryview | bytes) -> Any:
+    """Deserialize from a buffer produced by write_to/pack.
+
+    Out-of-band buffers are reconstructed as zero-copy memoryviews into
+    ``view`` — keep the backing shared memory mapped while the value lives.
+    """
+    view = memoryview(view)
+    magic, pickle_len, nbuf = struct.unpack_from("<8sII", view, 0)
+    if magic != MAGIC:
+        raise ValueError("corrupt serialized object (bad magic)")
+    off = 16
+    buf_lens = struct.unpack_from(f"<{nbuf}Q", view, off)
+    off += 8 * nbuf
+    payload = view[off : off + pickle_len]
+    off = _align(off + pickle_len)
+    buffers = []
+    for n in buf_lens:
+        buffers.append(view[off : off + n])
+        off = _align(off + n)
+    return pickle.loads(payload, buffers=buffers)
+
+
+def prepare_value(value: Any) -> Any:
+    """Convert device arrays to host numpy before serialization.
+
+    jax.Arrays are fetched to host; everything else passes through.
+    Imported lazily so the core runtime works without jax present.
+    """
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(value, jax.Array):
+        import numpy as np
+
+        return np.asarray(value)
+    return value

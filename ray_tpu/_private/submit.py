@@ -1,0 +1,72 @@
+"""Shared task-submission helpers (driver + nested worker submission).
+
+Reference: the submission half of the core worker —
+CoreWorker::SubmitTask (core_worker.cc:2149) + the option validation in
+_private/ray_option_utils.py:123.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from . import serialization
+from .ids import ObjectID
+from ..object_ref import ObjectRef
+
+
+def function_id_for(blob: bytes) -> bytes:
+    return hashlib.blake2b(blob, digest_size=16).digest()
+
+
+def prepare_args(args: tuple, kwargs: dict) -> Tuple[bytes, List[ObjectID]]:
+    """Serialize call args; top-level ObjectRefs become task dependencies."""
+    deps: List[ObjectID] = []
+    for a in args:
+        if isinstance(a, ObjectRef):
+            deps.append(a.id())
+    for v in kwargs.values():
+        if isinstance(v, ObjectRef):
+            deps.append(v.id())
+    prepared_args = [serialization.prepare_value(a) for a in args]
+    prepared_kwargs = {k: serialization.prepare_value(v) for k, v in kwargs.items()}
+    blob = serialization.pack((prepared_args, prepared_kwargs))
+    return blob, deps
+
+
+def resolve_options(
+    defaults: Dict[str, Any], overrides: Optional[Dict[str, Any]]
+) -> Dict[str, Any]:
+    opts = dict(defaults)
+    if overrides:
+        for k, v in overrides.items():
+            if v is not None or k not in opts:
+                opts[k] = v
+    return opts
+
+
+def resources_from_options(opts: Dict[str, Any], is_actor: bool = False) -> Dict[str, float]:
+    """Tasks default to 1 CPU; actors default to 0 for their lifetime
+    (reference: ray_option_utils.py — num_cpus default 1 for tasks,
+    0 for actors so many idle actors don't hold cores)."""
+    res: Dict[str, float] = {}
+    num_cpus = opts.get("num_cpus")
+    if num_cpus is None:
+        num_cpus = 0 if is_actor else 1
+    if num_cpus:
+        res["CPU"] = float(num_cpus)
+    num_tpus = opts.get("num_tpus")
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    num_gpus = opts.get("num_gpus")
+    if num_gpus:
+        res["GPU"] = float(num_gpus)
+    extra = opts.get("resources")
+    if extra:
+        res.update({k: float(v) for k, v in extra.items()})
+    return res
+
+
+def pickle_by_value(obj: Any) -> bytes:
+    return cloudpickle.dumps(obj)

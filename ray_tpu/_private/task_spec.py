@@ -1,0 +1,60 @@
+"""Immutable task description (reference: src/ray/common/task/task_spec.h:247).
+
+A TaskSpec fully describes one invocation: the function (by id, with the
+cloudpickled blob shipped once and cached in the GCS function table —
+reference: _private/function_manager.py), serialized args with the
+ObjectRefs they depend on, resource demands, and actor/placement options.
+
+The scheduling class (resource-shape equivalence class, reference
+task_spec.h:75) is derived from the sorted resource dict and used for
+fair dispatch queues.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    name: str
+    function_id: bytes
+    # cloudpickle blob of the function / actor class; None when the GCS
+    # function table already has it (keyed by function_id).
+    function_blob: Optional[bytes]
+    # cloudpickle blob of (args, kwargs); ObjectRefs inside are pickled
+    # as refs and resolved (top-level only) by the executing worker.
+    args_blob: bytes
+    # ObjectIDs this task's top-level args depend on; the scheduler holds
+    # the task until all are ready.
+    dependencies: List[ObjectID] = field(default_factory=list)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    # Actor protocol: creation task pins its worker; method tasks route to
+    # that worker in order.
+    actor_creation: bool = False
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    max_restarts: int = 0
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    max_concurrency: int = 1
+    # Placement.
+    placement_group_id: Optional[PlacementGroupID] = None
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: Any = None
+    # Named / detached actors.
+    actor_name: Optional[str] = None
+    lifetime: Optional[str] = None
+    runtime_env: Optional[Dict[str, Any]] = None
+
+    def scheduling_class(self) -> Tuple[Tuple[str, float], ...]:
+        return tuple(sorted(self.resources.items()))
+
+    def return_object_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
+        ]

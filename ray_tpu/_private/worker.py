@@ -1,0 +1,185 @@
+"""Per-process global runtime state + the public API implementations.
+
+Reference: python/ray/_private/worker.py — the module-level ``global_worker``
+holding the core-worker connection, and the ``init/get/put/wait`` entry
+points (worker.py:1225,2539,2679,2744).
+"""
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from .client import CoreClient
+from .config import RayConfig
+from .node import Node, default_resources
+from ..exceptions import RayTpuError
+from ..object_ref import ObjectRef
+
+DRIVER_MODE = "driver"
+WORKER_MODE = "worker"
+
+
+class _GlobalState:
+    def __init__(self):
+        self.client: Optional[CoreClient] = None
+        self.node: Optional[Node] = None
+        self.mode: Optional[str] = None
+        self.lock = threading.RLock()
+
+    @property
+    def connected(self) -> bool:
+        return self.client is not None
+
+
+_global = _GlobalState()
+
+
+def global_client() -> CoreClient:
+    if _global.client is None:
+        # Auto-init like the reference does on first API use.
+        init()
+    return _global.client
+
+
+def is_initialized() -> bool:
+    return _global.connected
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    _system_config: Optional[Dict[str, Any]] = None,
+    ignore_reinit_error: bool = False,
+    _temp_dir: Optional[str] = None,
+):
+    """Start a local cluster (head) or connect to an existing one.
+
+    ``address`` is the head's session socket path (from ``node.address``);
+    None starts a new local head in-process, as the reference does
+    (reference: _private/worker.py:1225 → Node head bring-up).
+    """
+    with _global.lock:
+        if _global.connected:
+            if ignore_reinit_error:
+                return _global.client
+            raise RayTpuError("ray_tpu.init() called twice; shutdown() first")
+        RayConfig.initialize(_system_config)
+        if address is None:
+            node = Node(
+                default_resources(num_cpus, num_tpus, resources), temp_dir=_temp_dir
+            )
+            _global.node = node
+            address_, authkey = node.address, node.authkey
+        else:
+            # address format: "<socket_path>?<authkey_hex>"
+            address_, authkey_hex = address.rsplit("?", 1)
+            authkey = bytes.fromhex(authkey_hex)
+        _global.client = CoreClient(address_, authkey, role=DRIVER_MODE)
+        _global.mode = DRIVER_MODE
+        atexit.register(_atexit_shutdown)
+        return _global.client
+
+
+def connect_existing(client: CoreClient, mode: str):
+    """Adopt an already-connected client (worker processes)."""
+    with _global.lock:
+        _global.client = client
+        _global.mode = mode
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    with _global.lock:
+        if _global.client is not None and _global.mode == DRIVER_MODE:
+            try:
+                _global.client.close()
+            except Exception:
+                pass
+        if _global.node is not None:
+            _global.node.shutdown()
+        _global.client = None
+        _global.node = None
+        _global.mode = None
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None
+) -> Any:
+    client = global_client()
+    if isinstance(refs, ObjectRef):
+        return client.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or list, got {type(refs)}")
+    return client.get(list(refs), timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed")
+    return global_client().put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() requires unique ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return global_client().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def free(refs: Sequence[ObjectRef]):
+    global_client().free(list(refs))
+
+
+def kill(actor_handle, *, no_restart: bool = True):
+    from ..actor import ActorHandle
+
+    if not isinstance(actor_handle, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    global_client().request(
+        {
+            "type": "kill_actor",
+            "actor_id": actor_handle._actor_id.binary(),
+            "reason": "ray_tpu.kill",
+        }
+    )
+
+
+def get_actor(name: str):
+    from ..actor import ActorHandle
+    from .ids import ActorID
+
+    reply = global_client().request({"type": "get_actor", "name": name})
+    if not reply.get("ok"):
+        raise ValueError(f"Failed to look up actor '{name}'")
+    return ActorHandle(ActorID(reply["actor_id"]))
+
+
+def cluster_resources() -> Dict[str, float]:
+    return global_client().cluster_info()["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    return global_client().cluster_info()["available"]
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return global_client().cluster_info()["nodes"]

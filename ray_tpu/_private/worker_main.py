@@ -1,0 +1,249 @@
+"""Worker process: task execution loop.
+
+Reference: the worker side of the core worker — HandlePushTask →
+ExecuteTask (core_worker.cc:2889) and the Cython execute_task hot loop
+(_raylet.pyx:1731): deserialize args, run the function, serialize
+returns (small → inline, large → shm store), report completion.
+
+One process per worker. Normal tasks run serially on the main thread.
+An actor-creation task pins the process to that actor; subsequent method
+calls run serially (ordered), on a thread pool when max_concurrency > 1,
+or on an asyncio loop for coroutine methods (async actors execute
+concurrently, as in the reference's fiber-based async actors —
+transport/fiber.h).
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import queue
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from . import serialization
+from .client import CoreClient
+from .config import RayConfig
+from .ids import WorkerID
+from .task_spec import TaskSpec
+from ..exceptions import RayTaskError
+from ..object_ref import ObjectRef
+
+
+class WorkerRuntime:
+    def __init__(self, client: CoreClient, task_queue: "queue.Queue[Optional[TaskSpec]]"):
+        self.client = client
+        self.task_queue = task_queue
+        self.fn_cache: Dict[bytes, Any] = {}
+        self.actor_instance: Any = None
+        self.actor_id: Optional[bytes] = None
+        self.max_concurrency = 1
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._done = threading.Event()
+
+    # -------------------------------------------------------------- resolve
+
+    def _resolve_function(self, spec: TaskSpec) -> Any:
+        fn = self.fn_cache.get(spec.function_id)
+        if fn is None:
+            blob = spec.function_blob or self.client.fetch_function(spec.function_id)
+            fn = cloudpickle.loads(blob)
+            self.fn_cache[spec.function_id] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec):
+        args, kwargs = serialization.unpack(spec.args_blob)
+        # Top-level ObjectRefs are resolved to values; nested refs pass
+        # through as refs (the reference's borrowing semantics).
+        args = [
+            self.client.get([a])[0] if isinstance(a, ObjectRef) else a for a in args
+        ]
+        kwargs = {
+            k: self.client.get([v])[0] if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        return args, kwargs
+
+    # -------------------------------------------------------------- execute
+
+    def _run_user_code(self, spec: TaskSpec):
+        args, kwargs = self._resolve_args(spec)
+        if spec.actor_creation:
+            cls = self._resolve_function(spec)
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_id = spec.actor_id.binary()
+            self.max_concurrency = spec.max_concurrency
+            if self.max_concurrency > 1:
+                self._pool = ThreadPoolExecutor(max_workers=self.max_concurrency)
+            return None
+        if spec.actor_id is not None:
+            if spec.method_name == "__ray_terminate__":
+                self.client.send(
+                    {"type": "actor_exit", "actor_id": spec.actor_id.binary()}
+                )
+                self._done.set()
+                self.task_queue.put(None)
+                return None
+            method = getattr(self.actor_instance, spec.method_name)
+            return method(*args, **kwargs)
+        fn = self._resolve_function(spec)
+        return fn(*args, **kwargs)
+
+    def _submit_async(self, spec: TaskSpec):
+        """Run a coroutine method on the actor's event loop without blocking
+        the dispatch thread — async actor calls execute concurrently
+        (reference: fiber-based async actors, transport/fiber.h:17)."""
+        if self._aio_loop is None:
+            self._aio_loop = asyncio.new_event_loop()
+            threading.Thread(
+                target=self._aio_loop.run_forever, name="actor-aio", daemon=True
+            ).start()
+
+        async def runner():
+            args, kwargs = self._resolve_args(spec)
+            method = getattr(self.actor_instance, spec.method_name)
+            return await method(*args, **kwargs)
+
+        fut = asyncio.run_coroutine_threadsafe(runner(), self._aio_loop)
+        fut.add_done_callback(lambda f: self._finish_async(spec, f))
+
+    def _finish_async(self, spec: TaskSpec, fut):
+        exc = fut.exception()
+        value = None if exc is not None else fut.result()
+        self._report_done(spec, value, exc)
+
+    def _report_done(self, spec: TaskSpec, value: Any, exc: Optional[BaseException]):
+        return_ids = spec.return_object_ids()
+        results = [{"object_id": oid.binary()} for oid in return_ids]
+        error_blob = None
+        if exc is not None:
+            if not isinstance(exc, RayTaskError):
+                exc = RayTaskError.from_exception(spec.name, exc)
+            try:
+                error_blob = serialization.pack(exc)
+            except Exception:
+                error_blob = serialization.pack(
+                    RayTaskError(spec.name, exc.traceback_str)
+                )
+        else:
+            values = (
+                list(value)
+                if spec.num_returns > 1
+                else [value]
+            )
+            if spec.num_returns > 1 and len(values) != spec.num_returns:
+                error_blob = serialization.pack(
+                    RayTaskError(
+                        spec.name,
+                        f"task declared num_returns={spec.num_returns} but "
+                        f"returned {len(values)} values",
+                    )
+                )
+            else:
+                for i, (oid, v) in enumerate(zip(return_ids, values)):
+                    v = serialization.prepare_value(v)
+                    payload, buffers = serialization.dumps(v)
+                    size = serialization.serialized_size(payload, buffers)
+                    if size <= RayConfig.max_inline_object_size:
+                        blob = bytearray(size)
+                        serialization.write_to(memoryview(blob), payload, buffers)
+                        results[i].update(inline=bytes(blob), size=size)
+                    else:
+                        from .client import object_segment_put
+
+                        name = object_segment_put(
+                            self.client.store, oid, payload, buffers, size
+                        )
+                        results[i].update(segment=name, size=size)
+        msg = {
+            "type": "task_done",
+            "worker_id": self.client.worker_id.binary(),
+            "task_id": spec.task_id.binary(),
+            "results": results,
+            "error": error_blob,
+        }
+        if spec.actor_creation:
+            msg["actor_creation"] = True
+            msg["actor_id"] = spec.actor_id.binary()
+        self.client.send(msg)
+
+    def _execute(self, spec: TaskSpec):
+        try:
+            value = self._run_user_code(spec)
+            exc = None
+        except BaseException as e:  # noqa: BLE001
+            value, exc = None, e
+        self._report_done(spec, value, exc)
+
+    # ------------------------------------------------------------------- loop
+
+    def run(self):
+        while not self._done.is_set():
+            spec = self.task_queue.get()
+            if spec is None:
+                break
+            is_actor_method = spec.actor_id is not None and not spec.actor_creation
+            if is_actor_method and spec.method_name != "__ray_terminate__":
+                method = getattr(self.actor_instance, spec.method_name, None)
+                if method is not None and asyncio.iscoroutinefunction(method):
+                    self._submit_async(spec)
+                    continue
+                if self._pool is not None:
+                    self._pool.submit(self._execute, spec)
+                    continue
+            self._execute(spec)
+
+
+def main():
+    address = os.environ["RAY_TPU_SESSION_ADDR"]
+    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+
+    # The queue exists before the connection: the GCS may push a task the
+    # instant our hello registers, on the reader thread.
+    task_queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
+
+    def push(msg):
+        t = msg["type"]
+        if t == "execute_task":
+            task_queue.put(msg["spec"])
+        elif t == "exit":
+            task_queue.put(None)
+
+    client = CoreClient(
+        address, authkey, role="worker", worker_id=worker_id,
+        push_handler=push,
+    )
+    rt = WorkerRuntime(client, task_queue)
+
+    # Make the ray_tpu API usable from inside tasks (nested submission).
+    from . import worker as worker_api
+
+    worker_api.connect_existing(client, mode="worker")
+
+    # Exit when the GCS goes away (driver died).
+    def watch_conn():
+        while True:
+            if client.conn.closed:
+                os._exit(0)
+            import time
+
+            time.sleep(0.5)
+
+    threading.Thread(target=watch_conn, daemon=True).start()
+
+    try:
+        rt.run()
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

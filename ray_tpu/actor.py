@@ -1,0 +1,175 @@
+"""Actor classes and handles.
+
+Reference: python/ray/actor.py — ActorClass._remote :854 (creation) and
+ActorMethod._remote :278 (method calls). Creation is centrally scheduled
+through the control plane (the reference's GcsActorManager/-Scheduler);
+method calls route to the actor's pinned worker in submission order.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Union
+
+from ._private import submit as _submit
+from ._private.ids import ActorID, PlacementGroupID, TaskID
+from ._private.task_spec import TaskSpec
+from ._private.worker import global_client
+from .object_ref import ObjectRef
+
+_VALID_ACTOR_OPTIONS = {
+    "num_cpus",
+    "num_gpus",
+    "num_tpus",
+    "resources",
+    "name",
+    "lifetime",
+    "max_restarts",
+    "max_task_retries",
+    "max_concurrency",
+    "get_if_exists",
+    "scheduling_strategy",
+    "placement_group",
+    "placement_group_bundle_index",
+    "runtime_env",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, *, num_returns: Optional[int] = None, name: Optional[str] = None):
+        return ActorMethod(
+            self._handle, self._method_name, num_returns or self._num_returns
+        )
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        client = global_client()
+        args_blob, deps = _submit.prepare_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            name=f"{self._method_name}",
+            function_id=self._handle._class_function_id,
+            function_blob=None,
+            args_blob=args_blob,
+            dependencies=deps,
+            num_returns=self._num_returns,
+            resources={},
+            actor_id=self._handle._actor_id,
+            method_name=self._method_name,
+        )
+        refs = client.submit(spec)
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._method_name}' cannot be called directly; "
+            f"use .remote()."
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_function_id: bytes = b"\x00" * 16):
+        self._actor_id = actor_id
+        self._class_function_id = class_function_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __ray_terminate__(self):  # pragma: no cover - attribute shadow helper
+        raise TypeError("use handle.__ray_terminate__.remote()")
+
+    @property
+    def __ray_terminate_method__(self) -> ActorMethod:
+        return ActorMethod(self, "__ray_terminate__")
+
+    def terminate(self) -> ObjectRef:
+        """Graceful exit: queued behind pending method calls."""
+        return ActorMethod(self, "__ray_terminate__").remote()
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_function_id))
+
+
+class ActorClass:
+    def __init__(self, cls: type, **default_options):
+        bad = set(default_options) - _VALID_ACTOR_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid actor options: {sorted(bad)}")
+        self._cls = cls
+        self._default_options = default_options
+        self._blob: Optional[bytes] = None
+        self._function_id: Optional[bytes] = None
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **options) -> "ActorClass":
+        merged = _submit.resolve_options(self._default_options, options)
+        clone = ActorClass(self._cls, **merged)
+        clone._blob = self._blob
+        clone._function_id = self._function_id
+        return clone
+
+    def _ensure_pickled(self):
+        if self._blob is None:
+            self._blob = _submit.pickle_by_value(self._cls)
+            self._function_id = _submit.function_id_for(self._blob)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        client = global_client()
+        self._ensure_pickled()
+        opts = self._default_options
+        name = opts.get("name")
+        if name and opts.get("get_if_exists"):
+            try:
+                from ._private.worker import get_actor
+
+                return get_actor(name)
+            except ValueError:
+                pass
+        args_blob, deps = _submit.prepare_args(args, kwargs)
+        actor_id = ActorID.from_random()
+        pg = opts.get("placement_group")
+        bundle_index = opts.get("placement_group_bundle_index", -1)
+        strategy = opts.get("scheduling_strategy")
+        if strategy is not None and hasattr(strategy, "placement_group"):
+            pg = strategy.placement_group
+            bundle_index = strategy.placement_group_bundle_index
+        pg_id: Optional[PlacementGroupID] = None
+        if pg is not None:
+            pg_id = pg.id if hasattr(pg, "id") else pg
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            name=f"{self._cls.__name__}.__init__",
+            function_id=self._function_id,
+            function_blob=client.register_function_once(self._function_id, self._blob),
+            args_blob=args_blob,
+            dependencies=deps,
+            num_returns=1,
+            resources=_submit.resources_from_options(opts, is_actor=True),
+            actor_creation=True,
+            actor_id=actor_id,
+            max_restarts=opts.get("max_restarts", 0) or 0,
+            max_concurrency=opts.get("max_concurrency", 1) or 1,
+            actor_name=name,
+            lifetime=opts.get("lifetime"),
+            placement_group_id=pg_id,
+            placement_group_bundle_index=(
+                bundle_index if bundle_index is not None else -1
+            ),
+            runtime_env=opts.get("runtime_env"),
+        )
+        client.submit(spec)
+        return ActorHandle(actor_id, self._function_id)

@@ -1,0 +1,62 @@
+"""ObjectRef: a first-class future naming an immutable object.
+
+Reference: the C++ ObjectID + Python ObjectRef (python/ray/includes/
+object_ref.pxi). Refs are picklable; passing a ref inside a task arg or
+return value keeps naming the same object (the reference calls this
+borrowing — reference_count.h:61). Round-1 lifetime model: objects live
+for the session (directory-driven free instead of distributed refcount).
+"""
+from __future__ import annotations
+
+from ._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner")
+
+    def __init__(self, object_id: ObjectID, owner: bytes = b""):
+        self._id = object_id
+        self._owner = owner
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id, self._owner))
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from . import get as _get
+        import concurrent.futures
+        import threading
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(_get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()

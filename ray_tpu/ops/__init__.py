@@ -1,0 +1,8 @@
+"""TPU ops: pallas kernels for the paths XLA doesn't already fuse well.
+
+Policy (SURVEY.md §7): let XLA fuse elementwise/norm/rope into matmuls;
+hand-write kernels only where blockwise algorithms beat materialization
+— attention (flash) and its ring/sequence-parallel variant.
+"""
+from .attention import flash_attention, attention_reference  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,124 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Net-new vs. the reference (SURVEY.md §5 "Long-context / sequence
+parallelism: absent in the reference ... must be first-class"). Each
+device holds a [B, H, T/n, D] shard of q/k/v. K/V shards rotate around
+the mesh axis with `lax.ppermute` (ICI neighbor exchange) while each
+device folds one block of scores per step into a running blockwise
+softmax (m, l, acc) — the flash-attention merge — so peak memory is
+O(T/n * T/n) per step and the full sequence is never gathered.
+
+Causality uses the global block index: block j contributes to block i
+iff j < i (full) or j == i (diagonal causal mask); j > i blocks are
+fully masked and contribute zero. Communication (one neighbor hop per
+step) overlaps with compute under XLA's latency-hiding scheduler.
+
+Differentiable: AD flows through scan + ppermute; the per-step body is
+`jax.checkpoint`ed so the backward pass recomputes block scores instead
+of storing n score matrices.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, sm_scale):
+    # [B, H, Tq, Tk] in f32
+    return (
+        jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * sm_scale
+    )
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-shard body; call inside shard_map with q/k/v sequence-sharded
+    along ``axis_name``. Shapes [B, H, T_local, D] (kv heads already
+    broadcast to H)."""
+    b, h, t, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / d**0.5
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+
+    qpos = jnp.arange(t)[:, None]
+    kpos = jnp.arange(t)[None, :]
+    diag_mask = qpos >= kpos  # causal mask within the diagonal block
+
+    def step(carry, s):
+        k_cur, v_cur, m, l, acc = carry
+        kv_idx = (my_idx - s) % n  # whose shard we currently hold
+        sc = _block_scores(q, k_cur, scale)
+        if causal:
+            block_mask = jnp.where(
+                kv_idx < my_idx,
+                jnp.ones((t, t), jnp.bool_),
+                jnp.where(kv_idx == my_idx, diag_mask, jnp.zeros((t, t), jnp.bool_)),
+            )
+            sc = jnp.where(block_mask[None, None], sc, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # Rotate kv to the next device (ring over ICI).
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, t, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, t, d), jnp.float32)
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        jax.checkpoint(step), (k, v, m0, l0, acc0), jnp.arange(n)
+    )
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe).astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+    head_axis: str = "tensor",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+) -> jax.Array:
+    """shard_map wrapper: global [B, H, T, D] arrays, sequence sharded over
+    ``seq_axis``, batch over ``batch_axes``, heads over ``head_axis``."""
+    hkv = k.shape[1]
+    if q.shape[1] != hkv:
+        k = jnp.repeat(k, q.shape[1] // hkv, axis=1)
+        v = jnp.repeat(v, q.shape[1] // hkv, axis=1)
+    spec = P(batch_axes, head_axis, seq_axis, None)
+    fn = shard_map(
+        lambda q_, k_, v_: ring_attention(
+            q_, k_, v_, axis_name=seq_axis, causal=causal, sm_scale=sm_scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,7 @@
+from .mesh import (  # noqa: F401
+    MeshSpec,
+    LOGICAL_RULES,
+    logical_sharding,
+    shard_params,
+    with_logical_constraint,
+)

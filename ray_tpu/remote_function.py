@@ -1,0 +1,92 @@
+"""@remote functions (reference: python/ray/remote_function.py —
+RemoteFunction._remote :266)."""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Union
+
+from ._private import submit as _submit
+from ._private.ids import PlacementGroupID, TaskID
+from ._private.task_spec import TaskSpec
+from ._private.worker import global_client
+from .object_ref import ObjectRef
+
+_VALID_OPTIONS = {
+    "num_cpus",
+    "num_gpus",
+    "num_tpus",
+    "num_returns",
+    "resources",
+    "max_retries",
+    "retry_exceptions",
+    "name",
+    "scheduling_strategy",
+    "placement_group",
+    "placement_group_bundle_index",
+    "runtime_env",
+}
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_options):
+        bad = set(default_options) - _VALID_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid @remote options: {sorted(bad)}")
+        self._fn = fn
+        self._default_options = default_options
+        self._blob: Optional[bytes] = None
+        self._function_id: Optional[bytes] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._fn.__name__}' cannot be called directly; "
+            f"use .remote()."
+        )
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = _submit.resolve_options(self._default_options, options)
+        clone = RemoteFunction(self._fn, **merged)
+        clone._blob = self._blob
+        clone._function_id = self._function_id
+        return clone
+
+    def _ensure_pickled(self):
+        if self._blob is None:
+            self._blob = _submit.pickle_by_value(self._fn)
+            self._function_id = _submit.function_id_for(self._blob)
+
+    def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
+        client = global_client()
+        self._ensure_pickled()
+        opts = self._default_options
+        args_blob, deps = _submit.prepare_args(args, kwargs)
+        num_returns = opts.get("num_returns", 1) or 1
+        pg = opts.get("placement_group")
+        pg_id: Optional[PlacementGroupID] = None
+        bundle_index = opts.get("placement_group_bundle_index", -1)
+        strategy = opts.get("scheduling_strategy")
+        if strategy is not None and hasattr(strategy, "placement_group"):
+            pg = strategy.placement_group
+            bundle_index = strategy.placement_group_bundle_index
+        if pg is not None:
+            pg_id = pg.id if hasattr(pg, "id") else pg
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            name=opts.get("name") or self._fn.__name__,
+            function_id=self._function_id,
+            function_blob=client.register_function_once(self._function_id, self._blob),
+            args_blob=args_blob,
+            dependencies=deps,
+            num_returns=num_returns,
+            resources=_submit.resources_from_options(opts),
+            max_retries=opts.get("max_retries", 0) or 0,
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            placement_group_id=pg_id,
+            placement_group_bundle_index=(
+                bundle_index if bundle_index is not None else -1
+            ),
+            runtime_env=opts.get("runtime_env"),
+        )
+        refs = client.submit(spec)
+        return refs[0] if num_returns == 1 else refs

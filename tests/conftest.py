@@ -1,0 +1,18 @@
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_start():
+    """Fresh local cluster per test (reference: conftest ray_start_regular)."""
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_cpus():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()

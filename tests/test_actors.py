@@ -1,0 +1,172 @@
+"""Actors: creation, ordering, named actors, failure semantics.
+
+Models the reference's python/ray/tests/test_actor.py coverage.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic(ray_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(10)) == 11
+
+
+def test_actor_constructor_args(ray_start):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_method_ordering(ray_start):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_actor_state_isolated(ray_start):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get(a.incr.remote())
+    assert ray_tpu.get(b.read.remote()) == 0
+
+
+def test_actor_handle_passing(ray_start):
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote())
+
+    c = Counter.remote()
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.read.remote()) == 1
+
+
+def test_named_actor(ray_start):
+    Counter.options(name="global_counter").remote(5)
+    time.sleep(0.1)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.read.remote()) == 5
+
+
+def test_named_actor_duplicate_fails(ray_start):
+    Counter.options(name="dup").remote()
+    # Give creation time to register the name.
+    time.sleep(0.3)
+    c2 = Counter.options(name="dup").remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(c2.read.remote(), timeout=10)
+
+
+def test_get_if_exists(ray_start):
+    a = Counter.options(name="gie", get_if_exists=True).remote(7)
+    ray_tpu.get(a.read.remote())  # ensure created
+    b = Counter.options(name="gie", get_if_exists=True).remote(7)
+    ray_tpu.get(a.incr.remote())
+    assert ray_tpu.get(b.read.remote()) == 8
+
+
+def test_kill_actor(ray_start):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote())
+    ray_tpu.kill(c)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(c.incr.remote(), timeout=10)
+
+
+def test_actor_creation_failure(ray_start):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("cannot construct")
+
+        def ping(self):
+            return "pong"
+
+    b = Broken.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.ping.remote(), timeout=10)
+
+
+def test_actor_method_error(ray_start):
+    @ray_tpu.remote
+    class Faulty:
+        def bad(self):
+            raise ValueError("method error")
+
+        def good(self):
+            return "ok"
+
+    f = Faulty.remote()
+    with pytest.raises(ValueError):
+        ray_tpu.get(f.bad.remote())
+    # Actor survives method errors.
+    assert ray_tpu.get(f.good.remote()) == "ok"
+
+
+def test_async_actor(ray_start):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get([a.work.remote(i) for i in range(5)]) == [0, 2, 4, 6, 8]
+
+
+def test_max_concurrency_threaded(ray_start):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def work(self):
+            time.sleep(0.3)
+            return 1
+
+    s = Slow.remote()
+    ray_tpu.get(s.work.remote())  # warm up: actor creation + worker spawn
+    start = time.monotonic()
+    ray_tpu.get([s.work.remote() for _ in range(4)])
+    elapsed = time.monotonic() - start
+    # 4 concurrent 0.3s calls should take well under 4*0.3s serial time.
+    assert elapsed < 1.0
+
+
+def test_actor_death_by_exit(ray_start):
+    @ray_tpu.remote
+    class Dying:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    d = Dying.remote()
+    assert ray_tpu.get(d.ping.remote()) == "pong"
+    d.die.remote()
+    with pytest.raises(RayActorError):
+        ray_tpu.get(d.ping.remote(), timeout=10)
+
+
+def test_actors_dont_hold_cpus(ray_start):
+    # Actors default to 0 CPUs for their lifetime, so many actors coexist
+    # on few cores (reference: ray_option_utils defaults).
+    counters = [Counter.remote() for _ in range(8)]
+    assert ray_tpu.get([c.incr.remote() for c in counters]) == [1] * 8

@@ -1,0 +1,132 @@
+"""Task retries + actor restarts + chaos.
+
+Reference semantics: max_retries re-submits on system failure
+(task_manager.h:468); retry_exceptions opts app errors into retries;
+max_restarts drives the GCS actor restart state machine
+(gcs_actor_manager.h:278, actor_states.rst). Chaos model:
+python/ray/tests/test_chaos.py.
+"""
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError, WorkerCrashedError
+
+
+def test_task_retry_on_crash(ray_start):
+    marker = f"/tmp/ray_tpu_retry_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky(path):
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)  # crash on first attempt
+        return "recovered"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=60) == "recovered"
+    os.unlink(marker)
+
+
+def test_task_no_retry_by_default(ray_start):
+    @ray_tpu.remote
+    def die():
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=30)
+
+
+def test_app_error_retry_with_retry_exceptions(ray_start):
+    marker = f"/tmp/ray_tpu_appretry_{os.getpid()}"
+    if os.path.exists(marker):
+        os.unlink(marker)
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky(path):
+        if not os.path.exists(path):
+            open(path, "w").close()
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=60) == "ok"
+    os.unlink(marker)
+
+
+def test_app_error_no_retry_without_flag(ray_start):
+    @ray_tpu.remote(max_retries=3)
+    def always_raises():
+        raise RuntimeError("app error")
+
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(always_raises.remote(), timeout=30)
+
+
+def test_actor_restart(ray_start):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.incr.remote()) == 1
+    p.die.remote()
+    # After restart, state resets (fresh __init__) but the handle works.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert ray_tpu.get(p.incr.remote(), timeout=10) == 1
+            break
+        except RayActorError:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not come back after restart")
+
+
+def test_actor_dies_after_restart_budget(ray_start):
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ping.remote()) == "pong"
+    f.die.remote()  # restart 1
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(f.ping.remote(), timeout=10)
+            break
+        except RayActorError:
+            time.sleep(0.2)
+    f.die.remote()  # exceeds budget
+    time.sleep(0.5)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(f.ping.remote(), timeout=10)
+
+
+def test_rpc_delay_injection():
+    # Reference: RAY_testing_asio_delay_us (ray_config_def.h:832).
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={"testing_rpc_delay_us": "put_object=30000:30000"},
+    )
+    try:
+        start = time.monotonic()
+        ray_tpu.get(ray_tpu.put(1))
+        assert time.monotonic() - start >= 0.03
+    finally:
+        ray_tpu.shutdown()
