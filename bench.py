@@ -1,0 +1,96 @@
+"""Headline benchmark: Llama train-step throughput / MFU on one TPU chip.
+
+Measures the end-to-end jitted training step (fwd + bwd + adamw update,
+remat on, bf16 compute) of the Llama-1B config at seq 2048 and reports
+tokens/sec/chip and model FLOPs utilization against the v5e peak.
+
+BASELINE.md north star: Llama finetune >=40% MFU. vs_baseline is
+MFU / 0.40 (>1.0 beats the target).
+
+Prints exactly one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def model_flops_per_token(cfg, seq_len: int) -> float:
+    """6N matmul flops/token + attention score flops
+    (12 * L * T * hidden per token, fwd+bwd)."""
+    n = cfg.num_params()
+    return 6.0 * n + 12.0 * cfg.num_layers * seq_len * cfg.hidden_size
+
+
+def main() -> int:
+    # Defaults sized to one v5e-lite chip (batch 4 OOMs with adamw state).
+    batch = int(os.environ.get("BENCH_BATCH", "1"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    model_name = os.environ.get("BENCH_MODEL", "llama-1b")
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))  # v5e bf16
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.models.llama import LlamaForCausalLM, causal_lm_loss
+
+    from dataclasses import replace
+
+    cfg = replace(CONFIGS[model_name], param_dtype=jnp.bfloat16)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    targets = jnp.roll(ids, -1, axis=1)
+
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), ids[:1, :8])
+    tx = optax.adamw(3e-4, b1=0.9, b2=0.95, mu_dtype=jnp.bfloat16)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, ids, targets):
+        def loss_fn(p):
+            return causal_lm_loss(model.apply(p, ids), targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # Warm up / compile. Timing closes with a scalar device->host fetch:
+    # on relayed/remote TPU backends block_until_ready can return before
+    # remote execution finishes, but a value fetch cannot.
+    params, opt_state, loss = train_step(params, opt_state, ids, targets)
+    _ = float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, ids, targets)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_s = tokens / dt
+    flops_per_tok = model_flops_per_token(cfg, seq)
+    mfu = tok_per_s * flops_per_tok / peak_flops
+
+    print(
+        json.dumps(
+            {
+                "metric": f"{model_name} train step tokens/s/chip (b{batch} s{seq}, "
+                f"loss {final_loss:.3f}, MFU {mfu:.3f})",
+                "value": round(tok_per_s, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.40, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

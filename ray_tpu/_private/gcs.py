@@ -79,6 +79,11 @@ class WorkerHandle:
     actor_id: Optional[ActorID] = None
     # Dispatched-but-unfinished specs (task_id -> spec); failed on death.
     inflight: Dict[bytes, TaskSpec] = field(default_factory=dict)
+    # TPU-visible worker: spawned with accelerator access (reference:
+    # accelerator visibility env vars set per worker —
+    # _private/accelerators/tpu.py TPU_VISIBLE_CHIPS). Non-TPU workers
+    # are pinned to CPU so they never contend for the chip.
+    tpu: bool = False
 
 
 @dataclass
@@ -153,6 +158,8 @@ class GcsServer:
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         self.actors: Dict[bytes, ActorState] = {}
         self.named_actors: Dict[str, bytes] = {}
+        # Method specs for reserved-but-not-yet-created named actors.
+        self._orphan_actor_tasks: Dict[bytes, List[TaskSpec]] = {}
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.nodes: Dict[bytes, NodeState] = {}
         self.placement_groups: Dict[bytes, PlacementGroupState] = {}
@@ -308,7 +315,8 @@ class GcsServer:
                     )
                     self.actors[aid] = actor
                     if spec.actor_name:
-                        if spec.actor_name in self.named_actors:
+                        holder = self.named_actors.get(spec.actor_name)
+                        if holder is not None and holder != aid:
                             self._fail_task_returns(
                                 spec,
                                 ValueError(
@@ -318,6 +326,8 @@ class GcsServer:
                             self.actors.pop(aid, None)
                             return
                         self.named_actors[spec.actor_name] = aid
+                    for orphan in self._orphan_actor_tasks.pop(aid, []):
+                        actor.pending.append(orphan)
                 self._pending.append(spec)
                 self._work.notify_all()
 
@@ -325,9 +335,16 @@ class GcsServer:
         """Dispatch an actor method to its pinned worker (ordered FIFO)."""
         aid = spec.actor_id.binary()
         actor = self.actors.get(aid)
-        if actor is None or actor.state == A_DEAD:
-            reason = actor.death_reason if actor else "actor not found"
-            self._fail_task_returns(spec, None, actor_error=reason)
+        if actor is None:
+            if aid in self.named_actors.values():
+                # Name reserved but the creation spec hasn't arrived yet
+                # (get_if_exists race window); buffer until it does.
+                self._orphan_actor_tasks.setdefault(aid, []).append(spec)
+                return
+            self._fail_task_returns(spec, None, actor_error="actor not found")
+            return
+        if actor.state == A_DEAD:
+            self._fail_task_returns(spec, None, actor_error=actor.death_reason)
             return
         if actor.state in (A_PENDING, A_RESTARTING):
             actor.pending.append(spec)
@@ -528,6 +545,33 @@ class GcsServer:
                 if k.startswith(msg.get("prefix", b""))
             ]
         state["peer"].reply(msg, ok=True, keys=keys)
+
+    def _h_reserve_actor_name(self, state, msg):
+        """Atomic get-or-reserve for named actors: returns the existing
+        actor id if the name is taken, else records name -> proposed id.
+        Eliminates the create/get race in get_if_exists (reference:
+        GcsActorManager named-actor registration)."""
+        with self._lock:
+            existing = self.named_actors.get(msg["name"])
+            if existing is not None:
+                state["peer"].reply(msg, ok=True, actor_id=existing, created=False)
+                return
+            self.named_actors[msg["name"]] = msg["actor_id"]
+        state["peer"].reply(msg, ok=True, actor_id=msg["actor_id"], created=True)
+
+    def _h_release_actor_name(self, state, msg):
+        """Undo a reservation whose creation never materialized (client-side
+        failure between reserve and submit)."""
+        with self._lock:
+            aid = self.named_actors.get(msg["name"])
+            if aid == msg["actor_id"] and aid not in self.actors:
+                self.named_actors.pop(msg["name"], None)
+                for spec in self._orphan_actor_tasks.pop(aid, []):
+                    self._fail_task_returns(
+                        spec, None, actor_error="actor creation never submitted"
+                    )
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True)
 
     def _h_get_actor(self, state, msg):
         with self._lock:
@@ -852,9 +896,10 @@ class GcsServer:
         progressed = False
         requeue: List[TaskSpec] = []
         # Each task that found resources but no worker claims one starting
-        # worker; we only spawn when claims exceed workers already starting
-        # (reference: worker_pool.cc PopWorker -> StartWorkerProcess).
-        claims: Dict[bytes, int] = {}
+        # worker of its kind; we only spawn when claims exceed workers
+        # already starting (reference: worker_pool.cc PopWorker ->
+        # StartWorkerProcess). Keyed by (node, needs_tpu).
+        claims: Dict[Tuple[bytes, bool], int] = {}
         while self._pending:
             spec = self._pending.popleft()
             if not self._deps_ready(spec):
@@ -877,18 +922,32 @@ class GcsServer:
                 # retry once a worker registers.
                 self._release_task_resources(spec, node.node_id)
                 requeue.append(spec)
-                nid = node.node_id.binary()
+                needs_tpu = spec.resources.get("TPU", 0) > 0
+                nid = (node.node_id.binary(), needs_tpu)
                 claims[nid] = claims.get(nid, 0) + 1
+                # Pool accounting is per worker kind: TPU workers are gated
+                # by TPU resource accounting, CPU workers by core count.
                 starting = sum(
                     1
                     for w in self.workers.values()
-                    if w.node_id.binary() == nid and w.state == W_STARTING
+                    if w.node_id == node.node_id
+                    and w.state == W_STARTING
+                    and w.tpu == needs_tpu
                 )
-                can_grow = spec.actor_creation or (
-                    len(node.pool) + starting < max(int(node.total.get("CPU", 1)), 1)
+                pool_same_kind = sum(
+                    1
+                    for wid in node.pool
+                    if (w := self.workers.get(wid)) is not None
+                    and w.tpu == needs_tpu
+                )
+                can_grow = (
+                    spec.actor_creation
+                    or needs_tpu
+                    or pool_same_kind + starting
+                    < max(int(node.total.get("CPU", 1)), 1)
                 )
                 if starting < claims[nid] and can_grow:
-                    self._spawn_worker(node)
+                    self._spawn_worker(node, tpu=needs_tpu)
                 continue
             worker.state = W_BUSY
             worker.current_task = spec
@@ -908,23 +967,36 @@ class GcsServer:
         return progressed
 
     def _pick_worker(self, node: NodeState, spec: TaskSpec) -> Optional[WorkerHandle]:
+        needs_tpu = spec.resources.get("TPU", 0) > 0
         for wid in list(node.pool):
             w = self.workers.get(wid)
-            if w is not None and w.state == W_IDLE and w.conn is not None:
+            if (
+                w is not None
+                and w.state == W_IDLE
+                and w.conn is not None
+                and w.tpu == needs_tpu
+            ):
                 if spec.actor_creation:
                     node.pool.discard(wid)
                 return w
         return None
 
-    def _spawn_worker(self, node: NodeState) -> WorkerHandle:
+    def _spawn_worker(self, node: NodeState, tpu: bool = False) -> WorkerHandle:
         self._worker_counter += 1
         wid = WorkerID.from_random()
-        w = WorkerHandle(worker_id=wid, node_id=node.node_id)
+        w = WorkerHandle(worker_id=wid, node_id=node.node_id, tpu=tpu)
         self.workers[wid.binary()] = w
         env = dict(os.environ)
         env["RAY_TPU_SESSION_ADDR"] = self.address
         env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
         env["RAY_TPU_WORKER_ID"] = wid.hex()
+        if not tpu:
+            # Pin non-TPU workers to CPU: strip accelerator-plugin hooks
+            # (this box's sitecustomize force-registers the TPU backend when
+            # PALLAS_AXON_POOL_IPS is set) and pin JAX_PLATFORMS, so only
+            # workers granted TPU resources can touch the chip.
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
         env.setdefault("PYTHONPATH", "")
         env["PYTHONPATH"] = (
             os.getcwd() + os.pathsep + sys.path[0] + os.pathsep + env["PYTHONPATH"]

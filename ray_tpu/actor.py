@@ -132,15 +132,32 @@ class ActorClass:
         self._ensure_pickled()
         opts = self._default_options
         name = opts.get("name")
-        if name and opts.get("get_if_exists"):
-            try:
-                from ._private.worker import get_actor
-
-                return get_actor(name)
-            except ValueError:
-                pass
-        args_blob, deps = _submit.prepare_args(args, kwargs)
         actor_id = ActorID.from_random()
+        if name:
+            # Atomic name reservation in the GCS (get-or-create).
+            reply = client.request(
+                {
+                    "type": "reserve_actor_name",
+                    "name": name,
+                    "actor_id": actor_id.binary(),
+                }
+            )
+            if not reply.get("created"):
+                if opts.get("get_if_exists"):
+                    return ActorHandle(ActorID(reply["actor_id"]), self._function_id)
+                raise ValueError(f"Actor name '{name}' is already taken")
+        try:
+            args_blob, deps = _submit.prepare_args(args, kwargs)
+        except BaseException:
+            if name:
+                client.send(
+                    {
+                        "type": "release_actor_name",
+                        "name": name,
+                        "actor_id": actor_id.binary(),
+                    }
+                )
+            raise
         pg = opts.get("placement_group")
         bundle_index = opts.get("placement_group_bundle_index", -1)
         strategy = opts.get("scheduling_strategy")

@@ -1,0 +1,224 @@
+"""Llama-family causal LM in flax, designed for GSPMD sharding.
+
+The reference framework ships no model code (models live in user code /
+integrations); the TPU rebuild needs a flagship model family to carry
+the Train/RLlib benchmarks (BASELINE.md: Llama-2-7B >=40% MFU on v5e).
+Architecture follows Llama-2: RMSNorm, rotary embeddings, GQA
+attention, SwiGLU MLP, untied or tied LM head.
+
+Sharding: parameters keep flax's standard naming so
+`parallel.mesh.spec_for_param` places them (kernel [in, out] ->
+(fsdp, tensor); embedding [vocab, embed] -> (tensor, fsdp)).
+Activations get in-graph constraints through
+`parallel.with_logical_constraint`. Attention dispatches to the pallas
+flash kernel on TPU and to ring attention when the mesh has a nontrivial
+`seq` axis (long-context sequence parallelism, net-new vs reference).
+
+Compute in bfloat16, parameters and reductions in float32 (MXU-friendly,
+HBM-light).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+from ..ops.ring_attention import ring_self_attention
+from ..parallel.mesh import with_logical_constraint
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    # Sequence parallelism: run attention as a ring over the mesh `seq`
+    # axis (requires an ambient mesh passed to __call__ via module attr).
+    remat: bool = True
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        h, i, v, l = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
+        hd = self.head_dim_
+        attn = h * (self.num_heads * hd) * 2 + h * (self.num_kv_heads * hd) * 2
+        mlp = 3 * h * i
+        per_layer = attn + mlp + 2 * h
+        emb = v * h * (1 if self.tie_embeddings else 2)
+        return l * per_layer + emb + h
+
+
+CONFIGS: Dict[str, LlamaConfig] = {
+    # test-size
+    "llama-tiny": LlamaConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=352, num_layers=2,
+        num_heads=4, num_kv_heads=2, max_seq_len=256,
+    ),
+    "llama-125m": LlamaConfig(
+        vocab_size=32000, hidden_size=768, intermediate_size=2048, num_layers=12,
+        num_heads=12, num_kv_heads=12, max_seq_len=2048,
+    ),
+    "llama-1b": LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5504, num_layers=22,
+        num_heads=16, num_kv_heads=16, max_seq_len=4096,
+    ),
+    "llama-3b": LlamaConfig(
+        vocab_size=32000, hidden_size=2560, intermediate_size=6912, num_layers=32,
+        num_heads=20, num_kv_heads=20, max_seq_len=4096,
+    ),
+    "llama-2-7b": LlamaConfig(),  # the Llama-2-7B shape
+}
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings. x [B, H, T, D], positions [B, T]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None, :, None].astype(jnp.float32) * freqs  # [B,1,T,D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype)
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        hd = cfg.head_dim_
+        dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+        )
+        q = dense((cfg.num_heads, hd), "q_proj")(x)
+        k = dense((cfg.num_kv_heads, hd), "k_proj")(x)
+        v = dense((cfg.num_kv_heads, hd), "v_proj")(x)
+        # [B, T, H, D] -> [B, H, T, D]
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        use_ring = (
+            self.mesh is not None and self.mesh.shape.get("seq", 1) > 1
+        )
+        if use_ring:
+            o = ring_self_attention(q, k, v, self.mesh, causal=True)
+        else:
+            o = flash_attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3)  # [B, T, H, D]
+        out = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="o_proj",
+        )(o)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="gate_proj")(x)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
+                      param_dtype=cfg.param_dtype, name="up_proj")(x)
+        h = nn.silu(gate) * up
+        h = with_logical_constraint(h, ("batch", "seq", "mlp"))
+        return nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="down_proj")(h)
+
+
+class DecoderLayer(nn.Module):
+    cfg: LlamaConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        h = x + Attention(cfg, mesh=self.mesh, name="attn")(
+            RMSNorm(cfg.rms_eps, cfg.param_dtype, name="input_norm")(x), positions
+        )
+        out = h + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_eps, cfg.param_dtype, name="post_attn_norm")(h)
+        )
+        return with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+class LlamaForCausalLM(nn.Module):
+    cfg: LlamaConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(input_ids.shape[1])[None], input_ids.shape
+            )
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="embed_tokens",
+        )
+        x = emb(input_ids)
+        x = with_logical_constraint(x, ("batch", "seq", "embed"))
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                DecoderLayer, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, mesh=self.mesh, name=f"layers_{i}")(x, positions)
+        x = RMSNorm(cfg.rms_eps, cfg.param_dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = emb.attend(x.astype(cfg.param_dtype))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                param_dtype=cfg.param_dtype, name="lm_head",
+            )(x)
+        return logits
+
+
+def causal_lm_loss(logits: jax.Array, targets: jax.Array,
+                   mask: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross-entropy in f32. logits [B, T, V], targets [B, T]
+    (already shifted by the data pipeline)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)

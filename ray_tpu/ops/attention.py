@@ -106,7 +106,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l_safe))[:, 0]
+        lse_ref[0] = m_scr[:] + jnp.log(l_safe)
 
 
 def _flash_fwd_pallas(q, k, v, *, causal, sm_scale, block_q, block_k):
@@ -140,11 +140,13 @@ def _flash_fwd_pallas(q, k, v, *, causal, sm_scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            # lse kept 3-D (bh, tq, 1) so the trailing dims satisfy TPU
+            # tiling (block_q % 8, last dim == full dim).
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq_p, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -160,7 +162,7 @@ def _flash_fwd_pallas(q, k, v, *, causal, sm_scale, block_q, block_k):
             transcendentals=bh * tq_p * tk_p,
         ),
     )(q, k, v)
-    return o[:, :tq], lse[:, :tq]
+    return o[:, :tq], lse[:, :tq, 0]
 
 
 # ------------------------------------------------------------------ custom vjp
@@ -240,9 +242,13 @@ def flash_attention(
     q [B, H, Tq, D]; k, v [B, Hkv, Tk, D], GQA via H % Hkv == 0.
     Uses the pallas kernel on TPU, XLA reference elsewhere.
     """
-    if not (_on_tpu() or force_pallas):
-        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     b, h, tq, d = q.shape
+    tk = k.shape[2]
+    # The kernel needs >=8x128-tileable blocks; tiny shapes (unit tests,
+    # short prompts) take the XLA path.
+    shapes_ok = tq >= 128 and tk >= 128 and d % 8 == 0
+    if not ((_on_tpu() and shapes_ok) or force_pallas):
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     hkv = k.shape[1]
     if h != hkv:
         k = jnp.repeat(k, h // hkv, axis=1)

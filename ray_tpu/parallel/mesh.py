@@ -119,12 +119,46 @@ def logical_sharding(mesh: Mesh, logical_axes: Sequence[Optional[str]]) -> Named
     return NamedSharding(mesh, logical_to_spec(logical_axes))
 
 
+def _ambient_mesh_axis_names():
+    """Axis names of the ambient mesh: jax.set_mesh context first, then
+    the legacy `with mesh:` resource env. None if neither is active."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is not None and mesh.axis_names:
+            return mesh.axis_names
+    except Exception:
+        pass
+    try:
+        from jax._src import mesh as mesh_lib
+
+        phys = mesh_lib.thread_resources.env.physical_mesh
+        if phys is not None and not phys.empty:
+            return phys.axis_names
+    except Exception:
+        pass
+    return None
+
+
 def with_logical_constraint(x, logical_axes: Sequence[Optional[str]]):
-    """In-jit sharding constraint by logical axis names (requires an
-    ambient mesh via `jax.sharding.use_mesh` or mesh context)."""
-    return jax.lax.with_sharding_constraint(
-        x, logical_to_spec(logical_axes)
-    )
+    """In-jit sharding constraint by logical axis names. No-op when there
+    is no ambient mesh (single-device runs, unit tests) so model code can
+    annotate unconditionally. Honors both `jax.set_mesh` and the legacy
+    `with mesh:` context."""
+    axis_names = _ambient_mesh_axis_names()
+    if axis_names is None:
+        return x
+    spec = logical_to_spec(logical_axes)
+    # Drop axes the ambient mesh doesn't have.
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in axis_names)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if entry in axis_names else None)
+    return jax.lax.with_sharding_constraint(x, P(*cleaned))
 
 
 def spec_for_param(path: Tuple[str, ...], shape: Tuple[int, ...]) -> P:

@@ -1,0 +1,89 @@
+"""Checkpoints: directory contract + orbax sharded pytree persistence.
+
+Reference: train/_checkpoint.py:56 (Checkpoint = directory + fs handle)
+and the TPU guidance in SURVEY.md §5: orbax-style async multi-host
+checkpoint of sharded arrays, keeping the report(metrics, checkpoint)
+contract.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Any, Optional
+
+
+class Checkpoint:
+    """A directory of checkpoint data (reference: train.Checkpoint)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """Synchronous sharded save via orbax (multi-host safe: every process
+    writes its addressable shards)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+
+
+def load_pytree(path: str, abstract_tree: Any = None) -> Any:
+    """Restore; pass an abstract tree (jax.ShapeDtypeStruct leaves with
+    shardings) to restore sharded onto a mesh."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if abstract_tree is None:
+            return ckptr.restore(os.path.abspath(path))
+        return ckptr.restore(os.path.abspath(path), abstract_tree)
+
+
+class AsyncCheckpointer:
+    """Async sharded checkpointing: device->host copy happens at save()
+    call; serialization proceeds in background threads (orbax
+    AsyncCheckpointer), keeping the TPU busy (SURVEY.md §5 checkpoint/
+    resume TPU equivalent)."""
+
+    def __init__(self):
+        import orbax.checkpoint as ocp
+
+        self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+
+    def save(self, path: str, tree: Any) -> None:
+        path = os.path.abspath(path)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        import orbax.checkpoint as ocp
+
+        self._ckptr.save(path, args=ocp.args.StandardSave(tree))
+
+    def wait(self) -> None:
+        self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._ckptr.close()

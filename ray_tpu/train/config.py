@@ -1,0 +1,60 @@
+"""Train configuration dataclasses.
+
+Reference: air/config.py — ScalingConfig (:~200), RunConfig,
+FailureConfig (:395), CheckpointConfig. The TPU ScalingConfig carries a
+MeshSpec: where the reference scales by `num_workers` GPU processes
+under NCCL, a TPU job is `num_workers` host processes jointly driving
+one GSPMD mesh (axes dp/fsdp/seq/tp/ep) — the mesh IS the parallelism
+declaration.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..parallel.mesh import MeshSpec
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    mesh: Optional[MeshSpec] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker)
+        if not res:
+            res = {"TPU": 1.0} if self.use_tpu else {"CPU": 1.0}
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+
+@dataclass
+class Result:
+    """Reference: air/result.py."""
+
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Any]
+    error: Optional[BaseException]
+    path: Optional[str]
+    metrics_history: list = field(default_factory=list)

@@ -1,0 +1,249 @@
+"""JaxTrainer: gang-scheduled SPMD training over actor worker groups.
+
+Reference call stack (SURVEY.md §3.3): TorchTrainer.fit →
+BackendExecutor + WorkerGroup actors + per-worker _TrainSession with a
+report queue → TrainingIterator drains epoch results. This trainer
+keeps that architecture — N worker actors gang-placed via a placement
+group, session report contract, checkpoint persistence, group restart
+on failure (FailureConfig) — with the torch/NCCL backend replaced by
+the JAX model: each worker is one TPU host of a slice; worker 0's
+address seeds `jax.distributed.initialize` (coordinator brokered
+through the control plane KV, replacing the reference's
+NCCLUniqueIDStore actor — util/collective/util.py:9); the mesh from
+ScalingConfig spans all hosts' devices and XLA compiles the
+collectives.
+
+Single-worker mode (num_workers=1) drives the whole local mesh in one
+process — the bench path on one host.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ..exceptions import RayActorError
+from ..util.placement_group import placement_group, remove_placement_group
+from ..util.scheduling_strategies import PlacementGroupSchedulingStrategy
+from .checkpoint import Checkpoint
+from .config import Result, RunConfig, ScalingConfig
+from .session import TrainContext, get_session, init_session
+
+
+class TrainWorker:
+    """Actor wrapping one training process (reference:
+    RayTrainWorker — train/_internal/worker_group.py)."""
+
+    def __init__(self, rank: int, world_size: int, experiment_name: str,
+                 storage_path: Optional[str], coordinator: Optional[str] = None,
+                 num_processes: Optional[int] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.session = init_session(
+            TrainContext(
+                world_rank=rank,
+                world_size=world_size,
+                local_rank=rank,
+                node_rank=rank,
+                experiment_name=experiment_name,
+                storage_path=storage_path,
+            )
+        )
+        self._thread: Optional[threading.Thread] = None
+        if coordinator is not None and world_size > 1:
+            # Multi-host: join the jax.distributed cluster so all hosts see
+            # the global device set (SURVEY.md §5 distributed backend).
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes or world_size,
+                process_id=rank,
+            )
+
+    def run(self, train_loop: Callable, config: Dict[str, Any],
+            latest_checkpoint: Optional[str] = None) -> bool:
+        """Start the user loop in a background thread; results stream
+        through next_result()."""
+        self.session.context.latest_checkpoint = (
+            Checkpoint(latest_checkpoint) if latest_checkpoint else None
+        )
+
+        def runner():
+            try:
+                # The user loop may take (config) or no args (reference:
+                # train_loop_per_worker signature detection).
+                import inspect
+
+                if len(inspect.signature(train_loop).parameters) >= 1:
+                    train_loop(config or {})
+                else:
+                    train_loop()
+                self.session.finish()
+            except BaseException as e:  # noqa: BLE001
+                traceback.print_exc()
+                self.session.finish(e)
+
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+        return True
+
+    def next_result(self):
+        kind, metrics, checkpoint = self.session.next_result()
+        if kind == "done":
+            err = self.session.error
+            if err is not None:
+                raise err if isinstance(err, Exception) else RuntimeError(str(err))
+            return ("done", None, None)
+        # Checkpoints are directories on shared storage; ship the path.
+        ckpt_path = checkpoint.path if isinstance(checkpoint, Checkpoint) else checkpoint
+        return (kind, metrics, ckpt_path)
+
+    def ping(self):
+        return self.rank
+
+
+class JaxTrainer:
+    """Reference: train/data_parallel_trainer.py:25 DataParallelTrainer;
+    fit() contract from base_trainer.py:567."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self._train_loop = train_loop_per_worker
+        self._config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(self) -> Result:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        name = self.run_config.name or f"JaxTrainer_{int(time.time())}"
+        storage = self.run_config.storage_path or os.path.join(
+            "/tmp/ray_tpu_results", name
+        )
+        os.makedirs(storage, exist_ok=True)
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        latest_ckpt: Optional[str] = None
+        while True:
+            try:
+                return self._fit_once(name, storage, latest_ckpt)
+            except RayActorError as e:
+                attempt += 1
+                if max_failures >= 0 and attempt > max_failures:
+                    return Result(
+                        metrics=None, checkpoint=None, error=e, path=storage
+                    )
+                latest_ckpt = self._latest_checkpoint_path(storage)
+
+    def _latest_checkpoint_path(self, storage: str) -> Optional[str]:
+        cands = sorted(
+            (d for d in os.listdir(storage) if d.startswith("checkpoint_")),
+            key=lambda d: int(d.split("_")[-1]),
+        )
+        return os.path.join(storage, cands[-1]) if cands else None
+
+    def _fit_once(self, name: str, storage: str, latest_ckpt: Optional[str]) -> Result:
+        sc = self.scaling_config
+        n = sc.num_workers
+        pg = placement_group(
+            [sc.worker_resources() for _ in range(n)],
+            strategy=sc.placement_strategy,
+        )
+        workers = []
+        try:
+            worker_cls = ray_tpu.remote(TrainWorker)
+            for rank in range(n):
+                workers.append(
+                    worker_cls.options(
+                        scheduling_strategy=PlacementGroupSchedulingStrategy(
+                            placement_group=pg,
+                            placement_group_bundle_index=rank,
+                        ),
+                        max_concurrency=2,
+                    ).remote(rank, n, name, storage)
+                )
+            ray_tpu.get([w.ping.remote() for w in workers], timeout=120)
+            cfg = self._config
+            if self.datasets:
+                cfg = dict(cfg or {})
+                cfg["__datasets__"] = self.datasets
+            ray_tpu.get(
+                [w.run.remote(self._train_loop, cfg, latest_ckpt) for w in workers],
+                timeout=120,
+            )
+            history = []
+            final_metrics = None
+            checkpoint = None
+            iteration = 0
+            while True:
+                results = ray_tpu.get(
+                    [w.next_result.remote() for w in workers]
+                )
+                kinds = {r[0] for r in results}
+                if "done" in kinds:
+                    break
+                iteration += 1
+                rank0_kind, metrics, ckpt_path = results[0]
+                final_metrics = metrics
+                history.append(metrics)
+                if ckpt_path:
+                    persisted = os.path.join(storage, f"checkpoint_{iteration:06d}")
+                    if os.path.abspath(ckpt_path) != persisted:
+                        import shutil
+
+                        shutil.copytree(ckpt_path, persisted, dirs_exist_ok=True)
+                    checkpoint = Checkpoint(persisted)
+                    self._prune_checkpoints(storage)
+            return Result(
+                metrics=final_metrics,
+                checkpoint=checkpoint,
+                error=None,
+                path=storage,
+                metrics_history=history,
+            )
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass
+
+    def _prune_checkpoints(self, storage: str):
+        keep = self.run_config.checkpoint_config.num_to_keep
+        if not keep:
+            return
+        cands = sorted(
+            (d for d in os.listdir(storage) if d.startswith("checkpoint_")),
+            key=lambda d: int(d.split("_")[-1]),
+        )
+        import shutil
+
+        for d in cands[:-keep]:
+            shutil.rmtree(os.path.join(storage, d), ignore_errors=True)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Resume checkpoint for the current session (reference:
+    train.get_checkpoint)."""
+    s = get_session()
+    if s is None:
+        return None
+    return getattr(s.context, "latest_checkpoint", None)

@@ -1,0 +1,184 @@
+"""Out-of-graph collectives over the object store.
+
+Reference: ray.util.collective (util/collective/collective.py —
+GroupManager :40, init_collective_group :120, allreduce/allgather/
+reducescatter/broadcast :258-615) with NCCL/GLOO backends and a named
+rendezvous actor holding the ncclUniqueId (util/collective/util.py:9).
+
+TPU mapping (SURVEY.md §5): *in-graph* collectives are XLA's job — psum
+and friends compiled into pjit programs over ICI; this module is the
+*out-of-graph* path for host-side tensor movement (weight broadcast to
+CPU rollout actors, cross-slice DCN transfers). The rendezvous actor
+became the group coordinator itself: an async actor that gathers each
+round's contributions through the shared-memory object store, reduces
+once, and hands every rank the result.
+
+API intentionally mirrors the reference so user code ports 1:1.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCE_OPS = {
+    "sum": lambda arrs: np.sum(arrs, axis=0),
+    "mean": lambda arrs: np.mean(arrs, axis=0),
+    "max": lambda arrs: np.max(arrs, axis=0),
+    "min": lambda arrs: np.min(arrs, axis=0),
+    "product": lambda arrs: np.prod(arrs, axis=0),
+}
+
+
+class _GroupCoordinator:
+    """Async actor: one instance per collective group."""
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        self.rounds: Dict[str, Dict[int, Any]] = {}
+        self.results: Dict[str, Any] = {}
+        self.events: Dict[str, "asyncio.Event"] = {}
+
+    def _event(self, key: str):
+        import asyncio
+
+        if key not in self.events:
+            self.events[key] = asyncio.Event()
+        return self.events[key]
+
+    async def contribute(self, key: str, rank: int, payload: Any, op: str):
+        contributions = self.rounds.setdefault(key, {})
+        contributions[rank] = payload
+        ev = self._event(key)
+        if len(contributions) == self.world_size:
+            ordered = [contributions[r] for r in range(self.world_size)]
+            if op in _REDUCE_OPS:
+                self.results[key] = _REDUCE_OPS[op](
+                    [np.asarray(a) for a in ordered]
+                )
+            elif op == "gather":
+                self.results[key] = [np.asarray(a) for a in ordered]
+            elif op == "barrier":
+                self.results[key] = True
+            elif op == "broadcast":
+                src = next(p for p in ordered if p is not None)
+                self.results[key] = np.asarray(src)
+            else:
+                raise ValueError(f"unknown collective op {op}")
+            ev.set()
+        else:
+            await ev.wait()
+        return self.results[key]
+
+    async def cleanup(self, key: str):
+        self.rounds.pop(key, None)
+        self.results.pop(key, None)
+        self.events.pop(key, None)
+        return True
+
+
+class _GroupState:
+    def __init__(self, name: str, world_size: int, rank: int, coordinator):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coordinator = coordinator
+        self.op_counter = 0
+        self.lock = threading.Lock()
+
+    def next_key(self, op: str) -> str:
+        with self.lock:
+            self.op_counter += 1
+            return f"{op}:{self.op_counter}"
+
+
+_groups: Dict[str, _GroupState] = {}
+_groups_lock = threading.Lock()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "xla",
+    group_name: str = "default",
+) -> None:
+    """Join a collective group; every member must call this
+    (reference: init_collective_group :120; NCCL rendezvous replaced by
+    a named coordinator actor)."""
+    if backend not in ("xla", "host"):
+        raise ValueError(f"unsupported backend {backend!r} (xla|host)")
+    coordinator_cls = ray_tpu.remote(_GroupCoordinator)
+    coordinator = coordinator_cls.options(
+        name=f"__collective_{group_name}", get_if_exists=True
+    ).remote(world_size)
+    with _groups_lock:
+        _groups[group_name] = _GroupState(group_name, world_size, rank, coordinator)
+
+
+def _group(group_name: str) -> _GroupState:
+    with _groups_lock:
+        g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group '{group_name}' not initialized in this process"
+        )
+    return g
+
+
+def _to_host(tensor: Any) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def allreduce(tensor: Any, group_name: str = "default", op: str = "sum") -> np.ndarray:
+    g = _group(group_name)
+    key = g.next_key(f"allreduce_{op}")
+    return ray_tpu.get(
+        g.coordinator.contribute.remote(key, g.rank, _to_host(tensor), op)
+    )
+
+
+def allgather(tensor: Any, group_name: str = "default") -> List[np.ndarray]:
+    g = _group(group_name)
+    key = g.next_key("allgather")
+    return ray_tpu.get(
+        g.coordinator.contribute.remote(key, g.rank, _to_host(tensor), "gather")
+    )
+
+
+def reducescatter(tensor: Any, group_name: str = "default", op: str = "sum") -> np.ndarray:
+    g = _group(group_name)
+    key = g.next_key(f"reducescatter_{op}")
+    full = ray_tpu.get(
+        g.coordinator.contribute.remote(key, g.rank, _to_host(tensor), op)
+    )
+    return np.array_split(full, g.world_size, axis=0)[g.rank]
+
+
+def broadcast(tensor: Optional[Any], src_rank: int = 0, group_name: str = "default") -> np.ndarray:
+    g = _group(group_name)
+    key = g.next_key("broadcast")
+    payload = _to_host(tensor) if g.rank == src_rank else None
+    return ray_tpu.get(
+        g.coordinator.contribute.remote(key, g.rank, payload, "broadcast")
+    )
+
+
+def barrier(group_name: str = "default") -> None:
+    g = _group(group_name)
+    key = g.next_key("barrier")
+    ray_tpu.get(g.coordinator.contribute.remote(key, g.rank, None, "barrier"))
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _groups_lock:
+        g = _groups.pop(group_name, None)
+    if g is not None and g.rank == 0:
+        try:
+            ray_tpu.kill(g.coordinator)
+        except Exception:
+            pass

@@ -65,11 +65,8 @@ def test_named_actor(ray_start):
 
 def test_named_actor_duplicate_fails(ray_start):
     Counter.options(name="dup").remote()
-    # Give creation time to register the name.
-    time.sleep(0.3)
-    c2 = Counter.options(name="dup").remote()
-    with pytest.raises(Exception):
-        ray_tpu.get(c2.read.remote(), timeout=10)
+    with pytest.raises(ValueError, match="already taken"):
+        Counter.options(name="dup").remote()
 
 
 def test_get_if_exists(ray_start):

@@ -1,0 +1,43 @@
+"""Out-of-graph collective API (reference: util/collective tests)."""
+import numpy as np
+
+import ray_tpu
+
+
+def _worker(rank, world, value):
+    from ray_tpu.util import collective as col
+
+    col.init_collective_group(world, rank, group_name="g1")
+    reduced = col.allreduce(np.full((4,), value, np.float32), group_name="g1")
+    gathered = col.allgather(np.array([rank], np.int32), group_name="g1")
+    bcast = col.broadcast(
+        np.array([42.0]) if rank == 0 else None, src_rank=0, group_name="g1"
+    )
+    col.barrier(group_name="g1")
+    return reduced.tolist(), [int(x[0]) for x in gathered], float(bcast[0])
+
+
+def test_collective_ops(ray_start):
+    world = 3
+    f = ray_tpu.remote(_worker)
+    results = ray_tpu.get(
+        [f.remote(r, world, float(r + 1)) for r in range(world)], timeout=60
+    )
+    for reduced, gathered, b in results:
+        assert reduced == [6.0, 6.0, 6.0, 6.0]  # 1+2+3
+        assert gathered == [0, 1, 2]
+        assert b == 42.0
+
+
+def test_reducescatter(ray_start):
+    def worker(rank, world):
+        from ray_tpu.util import collective as col
+
+        col.init_collective_group(world, rank, group_name="rs")
+        shard = col.reducescatter(np.arange(4, dtype=np.float32), group_name="rs")
+        return shard.tolist()
+
+    f = ray_tpu.remote(worker)
+    out = ray_tpu.get([f.remote(r, 2) for r in range(2)], timeout=60)
+    # sum = [0,2,4,6]; rank0 gets [0,2], rank1 [4,6]
+    assert out == [[0.0, 2.0], [4.0, 6.0]]

@@ -1,0 +1,147 @@
+"""JaxTrainer: worker group, report contract, checkpoints, restart.
+
+Models reference coverage in python/ray/train/tests (backend executor,
+session, checkpointing) on the local cluster.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture
+def ray4(tmp_path):
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield str(tmp_path)
+    ray_tpu.shutdown()
+
+
+def test_single_worker_report(ray4):
+    def loop(config):
+        for i in range(3):
+            rt_train.report({"iter": i, "loss": 1.0 / (i + 1)})
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=ray4),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["iter"] == 2
+    assert len(result.metrics_history) == 3
+
+
+def test_multi_worker_ranks(ray4):
+    def loop(config):
+        ctx = rt_train.get_context()
+        rt_train.report({"rank": ctx.world_rank, "world": ctx.world_size})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=3),
+        run_config=RunConfig(storage_path=ray4),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["world"] == 3
+    assert result.metrics["rank"] == 0  # rank-0 metrics surface
+
+
+def test_checkpoint_persistence(ray4):
+    def loop(config):
+        ctx = rt_train.get_context()
+        for i in range(2):
+            if ctx.world_rank == 0:
+                d = f"/tmp/ray_tpu_test_ckpt_{os.getpid()}_{i}"
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, "state.txt"), "w") as f:
+                    f.write(f"iter={i}")
+                rt_train.report({"iter": i}, checkpoint=Checkpoint(d))
+            else:
+                rt_train.report({"iter": i})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=ray4),
+    ).fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    with open(os.path.join(result.checkpoint.as_directory(), "state.txt")) as f:
+        assert f.read() == "iter=1"
+
+
+def test_train_error_surfaces(ray4):
+    def loop(config):
+        raise ValueError("train loop blew up")
+
+    with pytest.raises(Exception):
+        JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(storage_path=ray4),
+        ).fit()
+
+
+def test_jax_training_in_worker(ray4):
+    """End-to-end: real jax training inside the worker actor (CPU)."""
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models import CONFIGS, LlamaForCausalLM
+        from ray_tpu.models.llama import causal_lm_loss
+
+        cfg = CONFIGS["llama-tiny"]
+        model = LlamaForCausalLM(cfg)
+        ids = jnp.ones((2, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)
+        tx = optax.sgd(1e-2)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(p, o):
+            loss, g = jax.value_and_grad(
+                lambda p_: causal_lm_loss(model.apply(p_, ids), ids)
+            )(p)
+            up, o = tx.update(g, o)
+            return optax.apply_updates(p, up), o, loss
+
+        losses = []
+        for _ in range(3):
+            params, opt, loss = step(params, opt)
+            losses.append(float(loss))
+            rt_train.report({"loss": float(loss)})
+        assert losses[-1] <= losses[0]
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=ray4),
+    ).fit()
+    assert result.error is None
+    assert "loss" in result.metrics
+
+
+def test_orbax_save_load_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from ray_tpu.train import load_pytree, save_pytree
+
+    tree = {"w": jnp.arange(8.0).reshape(2, 4), "step": jnp.asarray(3)}
+    path = str(tmp_path / "ckpt")
+    save_pytree(path, tree)
+    restored = load_pytree(path)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.asarray(tree["w"]))
