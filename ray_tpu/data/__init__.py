@@ -1,0 +1,53 @@
+"""ray_tpu.data: distributed datasets for TPU training ingest.
+
+Reference: python/ray/data — lazy logical plans over distributed blocks,
+streaming execution, and Train ingest via streaming_split. The TPU twist
+is the consumption edge: `iter_jax_batches` / `to_device` place numpy
+batches directly as (optionally sharded) jax arrays.
+"""
+from .block import Block, BlockAccessor, BlockMetadata
+from .dataset import (
+    Dataset,
+    MaterializedDataset,
+    Schema,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_tfrecords,
+)
+from .datasource import Datasource, ReadTask
+from .iterator import DataIterator
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "MaterializedDataset",
+    "ReadTask",
+    "Schema",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_tfrecords",
+]

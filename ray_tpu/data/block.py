@@ -1,0 +1,190 @@
+"""Blocks: the unit of distributed data.
+
+Reference: python/ray/data/block.py — ``Block`` (an Arrow table),
+``BlockAccessor`` (format-generic accessor), ``BlockMetadata``. The
+canonical in-store block here is a ``pyarrow.Table``; batches convert on
+demand to numpy-dict / pandas / pyarrow ("batch_format"), and the numpy
+path is zero-copy where arrow layout allows so ``jax.device_put`` can
+consume it directly (SURVEY.md §7 phase 7: zero-copy numpy → device).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+# A "batch" handed to user fns: dict of numpy arrays, pandas DataFrame,
+# or a pyarrow Table, per batch_format.
+DataBatch = Union[Dict[str, np.ndarray], "pa.Table", Any]
+
+#: column name used for datasets of plain (non-dict) python/numpy items,
+#: mirroring the reference's TENSOR_COLUMN_NAME convention.
+VALUE_COL = "item"
+
+
+@dataclass
+class BlockMetadata:
+    """Stats the executor and optimizer need without fetching the block
+    (reference: data/block.py BlockMetadata)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: List[str] = field(default_factory=list)
+    exec_time_s: float = 0.0
+
+
+def _to_arrow_array(col: Any) -> pa.Array:
+    arr = np.asarray(col)
+    if arr.ndim > 1:
+        # Tensor columns: nested FixedSizeList keeps the layout columnar
+        # AND shape-preserving (reference: ArrowTensorArray semantics).
+        inner = pa.array(arr.reshape(-1))
+        for dim in reversed(arr.shape[1:]):
+            inner = pa.FixedSizeListArray.from_arrays(inner, dim)
+        return inner
+    return pa.array(arr)
+
+
+def _tensor_column_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
+    """Reassemble a nested-FixedSizeList column into one (N, d1, …) array."""
+    c = col.combine_chunks()
+    shape = []
+    n = len(c)
+    while pa.types.is_fixed_size_list(c.type):
+        shape.append(c.type.list_size)
+        c = c.flatten()  # flatten() respects slice offsets; .values does not
+    flat = c.to_numpy(zero_copy_only=False)
+    return flat.reshape((n, *shape))
+
+
+def _col_array(vals: list) -> pa.Array:
+    """Column from a list of row values; rebuilds tensor layout when the
+    values are uniform nested lists/arrays."""
+    try:
+        arr = np.asarray(vals)
+    except (ValueError, TypeError):
+        return pa.array(vals)
+    if arr.dtype == object:
+        return pa.array(vals)
+    return _to_arrow_array(arr)
+
+
+def build_block(data: Any) -> Block:
+    """Coerce rows/batch-like data into the canonical arrow block."""
+    if isinstance(data, pa.Table):
+        return data
+    if data is None:
+        return pa.table({})
+    try:
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(data, dict):
+        return pa.table({k: _to_arrow_array(v) for k, v in data.items()})
+    if isinstance(data, list):
+        if not data:
+            return pa.table({})
+        if isinstance(data[0], dict):
+            cols: Dict[str, list] = {k: [] for k in data[0]}
+            for row in data:
+                for k in cols:
+                    cols[k].append(row.get(k))
+            return pa.table({k: _col_array(v) for k, v in cols.items()})
+        return pa.table({VALUE_COL: _col_array(data)})
+    if isinstance(data, np.ndarray):
+        return pa.table({VALUE_COL: _to_arrow_array(data)})
+    raise TypeError(f"cannot build a block from {type(data)}")
+
+
+class BlockAccessor:
+    """Format-generic view over one block (reference: BlockAccessor.for_block)."""
+
+    def __init__(self, block: Block):
+        self._t = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(build_block(block))
+
+    def num_rows(self) -> int:
+        return self._t.num_rows
+
+    def size_bytes(self) -> int:
+        return self._t.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._t.schema
+
+    def to_arrow(self) -> pa.Table:
+        return self._t
+
+    def to_pandas(self):
+        return self._t.to_pandas()
+
+    def to_numpy_batch(self) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for name in self._t.column_names:
+            col = self._t.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                out[name] = _tensor_column_to_numpy(col)
+                continue
+            try:
+                out[name] = col.combine_chunks().to_numpy(zero_copy_only=False)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                out[name] = np.asarray(col.to_pylist(), dtype=object)
+        return out
+
+    def to_batch(self, batch_format: str) -> DataBatch:
+        if batch_format in ("numpy", "default", None):
+            return self.to_numpy_batch()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self._t
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for batch in self._t.to_batches():
+            yield from batch.to_pylist()
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._t.slice(start, end - start)
+
+    def take_indices(self, idx: np.ndarray) -> Block:
+        return self._t.take(pa.array(idx))
+
+    def sample_rows(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.RandomState(seed)
+        n = min(n, self._t.num_rows)
+        idx = rng.choice(self._t.num_rows, size=n, replace=False)
+        return self.take_indices(idx)
+
+    def metadata(self, input_files: Optional[List[str]] = None,
+                 exec_time_s: float = 0.0) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=self._t.num_rows,
+            size_bytes=self._t.nbytes,
+            schema=self._t.schema,
+            input_files=input_files or [],
+            exec_time_s=exec_time_s,
+        )
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    if len(blocks) == 1:
+        return blocks[0]
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def batch_to_block(batch: DataBatch) -> Block:
+    return build_block(batch)

@@ -1,0 +1,240 @@
+"""Datasources: pluggable readers producing parallel ReadTasks.
+
+Reference: python/ray/data/datasource/datasource.py (``Datasource``,
+``ReadTask``) and the per-format sources under data/_internal/datasource/
+(parquet, csv, json, range, binary…). A ReadTask is a zero-arg callable
+returning an iterator of blocks plus advance metadata; the Read logical
+operator schedules them as remote tasks.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from .block import Block, BlockAccessor, BlockMetadata, VALUE_COL, build_block
+
+
+class ReadTask:
+    def __init__(self, read_fn: Callable[[], Iterable[Block]],
+                 metadata: BlockMetadata):
+        self._read_fn = read_fn
+        self.metadata = metadata  # estimate; real stats come post-read
+
+    def __call__(self) -> Iterable[Block]:
+        return self._read_fn()
+
+
+class Datasource:
+    """Subclass and implement get_read_tasks (reference: Datasource)."""
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__.replace("Datasource", "")
+
+
+class RangeDatasource(Datasource):
+    """ray_tpu.data.range — deterministic integer range (reference:
+    data/_internal/datasource/range_datasource.py)."""
+
+    def __init__(self, n: int, use_tensor: bool = False, tensor_shape=None):
+        self._n = n
+        self._tensor_shape = tensor_shape
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        tasks = []
+        per = self._n // parallelism
+        rem = self._n % parallelism
+        start = 0
+        shape = self._tensor_shape
+        for i in range(parallelism):
+            cnt = per + (1 if i < rem else 0)
+            lo, hi = start, start + cnt
+            start = hi
+
+            def read(lo=lo, hi=hi) -> Iterable[Block]:
+                arr = np.arange(lo, hi)
+                if shape:
+                    data = np.broadcast_to(
+                        arr.reshape((-1,) + (1,) * len(shape)),
+                        (hi - lo,) + tuple(shape),
+                    ).copy()
+                    yield build_block({VALUE_COL: data})
+                else:
+                    yield pa.table({"id": pa.array(arr)})
+
+            meta = BlockMetadata(num_rows=cnt, size_bytes=cnt * 8)
+            tasks.append(ReadTask(read, meta))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = items
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self._items)
+        parallelism = max(1, min(parallelism, n or 1))
+        tasks = []
+        per, rem, start = n // parallelism, n % parallelism, 0
+        for i in range(parallelism):
+            cnt = per + (1 if i < rem else 0)
+            chunk = self._items[start:start + cnt]
+            start += cnt
+
+            def read(chunk=chunk) -> Iterable[Block]:
+                yield build_block(chunk)
+
+            tasks.append(ReadTask(read, BlockMetadata(num_rows=cnt, size_bytes=0)))
+        return tasks
+
+
+class _FileDatasource(Datasource):
+    """Shared path-expansion + per-file read tasks for file formats
+    (reference: file_based_datasource.py)."""
+
+    def __init__(self, paths, file_extensions: Optional[List[str]] = None,
+                 **read_kwargs):
+        if isinstance(paths, str):
+            paths = [paths]
+        files: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for f in sorted(glob.glob(os.path.join(p, "**", "*"),
+                                          recursive=True)):
+                    if os.path.isfile(f):
+                        files.append(f)
+            elif any(ch in p for ch in "*?["):
+                files.extend(sorted(glob.glob(p)))
+            else:
+                files.append(p)
+        if file_extensions:
+            exts = tuple(file_extensions)
+            files = [f for f in files if f.endswith(exts)]
+        if not files:
+            raise ValueError(f"No input files found for {paths}")
+        self._files = files
+        self._read_kwargs = read_kwargs
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        # one task per file group; group to reach ~parallelism tasks
+        n = len(self._files)
+        groups: List[List[str]] = []
+        parallelism = max(1, min(parallelism, n))
+        per, rem, start = n // parallelism, n % parallelism, 0
+        for i in range(parallelism):
+            cnt = per + (1 if i < rem else 0)
+            groups.append(self._files[start:start + cnt])
+            start += cnt
+        tasks = []
+        for grp in groups:
+            def read(grp=grp) -> Iterable[Block]:
+                for path in grp:
+                    yield from self._read_file(path)
+
+            size = sum(os.path.getsize(f) for f in grp)
+            tasks.append(ReadTask(
+                read,
+                BlockMetadata(num_rows=0, size_bytes=size, input_files=grp),
+            ))
+        return tasks
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return sum(os.path.getsize(f) for f in self._files)
+
+
+class ParquetDatasource(_FileDatasource):
+    def __init__(self, paths, columns: Optional[List[str]] = None, **kw):
+        super().__init__(paths, file_extensions=[".parquet"], **kw)
+        self._columns = columns
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import pyarrow.parquet as pq
+
+        yield pq.read_table(path, columns=self._columns)
+
+
+class CSVDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from pyarrow import csv as pa_csv
+
+        yield pa_csv.read_csv(path, **self._read_kwargs)
+
+
+class JSONDatasource(_FileDatasource):
+    """Newline-delimited JSON (reference: json_datasource.py)."""
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from pyarrow import json as pa_json
+
+        yield pa_json.read_json(path, **self._read_kwargs)
+
+
+class BinaryDatasource(_FileDatasource):
+    """Whole files as bytes rows with their paths (reference:
+    binary_datasource.py)."""
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        with open(path, "rb") as f:
+            data = f.read()
+        yield pa.table({"bytes": pa.array([data], type=pa.binary()),
+                        "path": pa.array([path])})
+
+
+class NumpyDatasource(_FileDatasource):
+    def _read_file(self, path: str) -> Iterator[Block]:
+        arr = np.load(path)
+        yield build_block({VALUE_COL: arr})
+
+
+class TFRecordsDatasource(_FileDatasource):
+    """Uncompressed TFRecord files of tf.train.Example records, parsed
+    without a tensorflow dependency (reference: tfrecords_datasource.py)."""
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from . import _tfrecord
+
+        rows = list(_tfrecord.read_examples(path))
+        cols: Dict[str, list] = {}
+        for row in rows:
+            for k, v in row.items():
+                cols.setdefault(k, []).append(v)
+        yield build_block(cols)
+
+
+# ------------------------------------------------------------------ writes
+
+def write_block_file(block: Block, path: str, fmt: str, **kw) -> str:
+    acc = BlockAccessor.for_block(block)
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(acc.to_arrow(), path, **kw)
+    elif fmt == "csv":
+        from pyarrow import csv as pa_csv
+
+        pa_csv.write_csv(acc.to_arrow(), path, **kw)
+    elif fmt == "json":
+        acc.to_pandas().to_json(path, orient="records", lines=True)
+    elif fmt == "numpy":
+        np.save(path, acc.to_numpy_batch()[VALUE_COL])
+    elif fmt == "tfrecords":
+        from . import _tfrecord
+
+        _tfrecord.write_examples(path, acc.iter_rows())
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+    return path

@@ -1,0 +1,98 @@
+"""Batch iteration: rebatch blocks into fixed-size batches with prefetch,
+and collate numpy batches onto TPU devices.
+
+Reference: python/ray/data/iterator.py (DataIterator.iter_batches :105)
+and the batcher in data/_internal/block_batching/. The device path is
+jax-native: ``jax.device_put`` with an optional NamedSharding so a global
+batch lands sharded across the mesh without a host gather (SURVEY.md §7
+zero-copy host→TPU goal).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from .block import BlockAccessor, concat_blocks
+
+
+def iter_batches_over_bundles(bundles: Iterator, *, batch_size: Optional[int],
+                              batch_format: str = "numpy",
+                              drop_last: bool = False,
+                              prefetch_blocks: int = 1):
+    """Fetch blocks with a sliding prefetch window and slice into batches."""
+
+    def fetched_blocks():
+        window: deque = deque()
+        for ref, _meta in bundles:
+            window.append(ref)
+            if len(window) > prefetch_blocks:
+                yield ray_tpu.get(window.popleft())
+        while window:
+            yield ray_tpu.get(window.popleft())
+
+    carry = None  # leftover arrow table
+    for block in fetched_blocks():
+        t = BlockAccessor.for_block(block).to_arrow()
+        if carry is not None and carry.num_rows:
+            t = concat_blocks([carry, t])
+            carry = None
+        if batch_size is None:
+            if t.num_rows:
+                yield BlockAccessor.for_block(t).to_batch(batch_format)
+            continue
+        start = 0
+        while t.num_rows - start >= batch_size:
+            piece = t.slice(start, batch_size)
+            start += batch_size
+            yield BlockAccessor.for_block(piece).to_batch(batch_format)
+        if start < t.num_rows:
+            carry = t.slice(start)
+    if carry is not None and carry.num_rows and not drop_last:
+        yield BlockAccessor.for_block(carry).to_batch(batch_format)
+
+
+def to_device(batch: Dict[str, np.ndarray], *, device=None, sharding=None):
+    """Place a numpy batch on device(s). With a sharding, each column is
+    placed as one global sharded array (DP/SP input feeding)."""
+    import jax
+
+    target = sharding if sharding is not None else device
+    if target is None:
+        return {k: jax.device_put(v) for k, v in batch.items()}
+    return {k: jax.device_put(v, target) for k, v in batch.items()}
+
+
+class DataIterator:
+    """A re-iterable handle over a dataset shard (reference: DataIterator)."""
+
+    def __init__(self, make_bundles, world_rank: Optional[int] = None):
+        self._make_bundles = make_bundles
+        self.world_rank = world_rank
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     prefetch_batches: int = 1):
+        return iter_batches_over_bundles(
+            self._make_bundles(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            prefetch_blocks=max(1, prefetch_batches),
+        )
+
+    def iter_rows(self):
+        for ref, _ in self._make_bundles():
+            yield from BlockAccessor.for_block(ray_tpu.get(ref)).iter_rows()
+
+    def iter_jax_batches(self, *, batch_size: int = 256, drop_last: bool = True,
+                         device=None, sharding=None):
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            yield to_device(batch, device=device, sharding=sharding)
+
+    def materialize(self):
+        from .dataset import MaterializedDataset
+
+        return MaterializedDataset(list(self._make_bundles()))

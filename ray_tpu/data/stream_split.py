@@ -1,0 +1,67 @@
+"""streaming_split: one dataset feeding N training workers in lockstep.
+
+Reference: python/ray/data/_internal/iterator/stream_split_iterator.py —
+a ``SplitCoordinator`` actor (:32,:128) runs the execution and serves
+output splits to N consumers, with an epoch barrier so every consumer
+sees the same epoch boundary. Each `DataIterator` handed to a Train
+worker pulls its split's blocks from the coordinator actor.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import ray_tpu
+from .iterator import DataIterator
+
+
+@ray_tpu.remote
+class SplitCoordinator:
+    """Runs one execution per epoch and serves N block streams."""
+
+    def __init__(self, dataset, n: int, equal: bool):
+        self._ds = dataset
+        self._n = n
+        self._equal = equal
+        self._epoch = -1
+        self._splits: List[List[Tuple]] = []
+        self._lock = threading.Lock()
+
+    def _start_epoch(self, epoch: int) -> None:
+        ds = self._ds.repartition(self._n) if self._equal else self._ds
+        bundles = list(ds.iter_internal_ref_bundles())
+        splits: List[List[Tuple]] = [[] for _ in range(self._n)]
+        for i, b in enumerate(bundles):
+            splits[i % self._n].append(b)
+        self._splits = splits
+        self._epoch = epoch
+
+    def get_split(self, rank: int, epoch: int) -> List[Tuple]:
+        """Blocking epoch barrier: first caller of a new epoch triggers
+        execution; all ranks then read the same epoch's split."""
+        with self._lock:
+            if epoch > self._epoch:
+                self._start_epoch(epoch)
+        return self._splits[rank]
+
+
+class SplitDataIterator(DataIterator):
+    def __init__(self, coordinator, rank: int):
+        self._coord = coordinator
+        self._rank = rank
+        self._epoch = -1
+
+        def make_bundles():
+            self._epoch += 1
+            bundles = ray_tpu.get(
+                self._coord.get_split.remote(self._rank, self._epoch)
+            )
+            return iter(bundles)
+
+        super().__init__(make_bundles, world_rank=rank)
+
+
+def make_streaming_splits(dataset, n: int, *, equal: bool = True
+                          ) -> List[SplitDataIterator]:
+    coord = SplitCoordinator.remote(dataset, n, equal)
+    return [SplitDataIterator(coord, i) for i in range(n)]
