@@ -88,9 +88,12 @@ def _sample_sort_keys(block: Block, key: str, n: int, seed: int):
 def _range_partition(block: Block, key: str, boundaries: List[Any], desc: bool):
     """Sort-map: split one block into len(boundaries)+1 key ranges."""
     acc = BlockAccessor.for_block(block)
+    n = len(boundaries) + 1
+    if acc.num_rows() == 0:
+        empty = acc.slice(0, 0)
+        return empty if n == 1 else [empty] * n
     keys = acc.to_numpy_batch()[key]
     idx = np.searchsorted(np.asarray(boundaries), keys, side="right")
-    n = len(boundaries) + 1
     parts = [acc.take_indices(np.nonzero(idx == i)[0]) for i in range(n)]
     if desc:
         parts = parts[::-1]
@@ -101,6 +104,8 @@ def _range_partition(block: Block, key: str, boundaries: List[Any], desc: bool):
 def _merge_sorted(key: str, desc: bool, *shards: Block):
     out = concat_blocks([BlockAccessor.for_block(s).to_arrow() for s in shards])
     acc = BlockAccessor.for_block(out)
+    if acc.num_rows() == 0:
+        return out, acc.metadata()
     keys = acc.to_numpy_batch()[key]
     order = np.argsort(keys, kind="stable")
     if desc:
@@ -326,19 +331,25 @@ class StreamingExecutor:
         samples = ray_tpu.get(
             [_sample_sort_keys.remote(ref, key, 20, i) for i, (ref, _) in enumerate(bundles)]
         )
-        keys = np.concatenate([np.atleast_1d(np.asarray(s)) for s in samples if s is not None])
+        nonempty = [np.atleast_1d(np.asarray(s)) for s in samples if s is not None]
+        keys = np.concatenate(nonempty) if nonempty else np.array([])
         keys.sort()
         boundaries = [
-            keys[int(len(keys) * (i + 1) / n)] for i in range(n - 1)
+            keys[min(int(len(keys) * (i + 1) / n), len(keys) - 1)]
+            for i in range(n - 1)
         ] if len(keys) else []
+        # partition count follows the boundaries (all-empty data -> 1)
+        n_out = len(boundaries) + 1
         parts = [
-            _range_partition.options(num_returns=n).remote(ref, key, boundaries, desc)
+            _range_partition.options(num_returns=n_out).remote(
+                ref, key, boundaries, desc
+            )
             for ref, _ in bundles
         ]
-        if n == 1:
+        if n_out == 1:
             parts = [[p] if not isinstance(p, list) else p for p in parts]
         out: List[Bundle] = []
-        for i in range(n):
+        for i in range(n_out):
             col = [p[i] for p in parts]
             b_ref, m_ref = _merge_sorted.remote(key, desc, *col)
             out.append((b_ref, ray_tpu.get(m_ref)))

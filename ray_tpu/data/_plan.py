@@ -48,10 +48,10 @@ class MapSpec:
                 out: List[Block] = []
                 n = acc.num_rows()
                 step = size or max(n, 1)
-                for start in range(0, max(n, 1), step):
+                # Never call the fn on an empty (schema-less) block — an
+                # upstream filter may have emptied it.
+                for start in range(0, n, step):
                     piece = BlockAccessor.for_block(acc.slice(start, min(start + step, n)))
-                    if piece.num_rows() == 0 and n > 0:
-                        continue
                     res = fn(piece.to_batch(fmt), **fn_kwargs)
                     out.append(batch_to_block(res))
                 block = concat_blocks(out) if out else build_block({})

@@ -92,6 +92,10 @@ def _parse_feature(buf: bytes) -> Any:
                     floats.append(struct.unpack("<f", v)[0])
             return floats[0] if len(floats) == 1 else floats
         if field == 3:  # int64_list
+            def signed(x: int) -> int:
+                # varints carry two's-complement int64
+                return x - (1 << 64) if x >= 1 << 63 else x
+
             ints: List[int] = []
             for f, wire, v in _fields(val):
                 if f != 1:
@@ -100,9 +104,9 @@ def _parse_feature(buf: bytes) -> Any:
                     pos = 0
                     while pos < len(v):
                         x, pos = _read_varint(v, pos)
-                        ints.append(x)
+                        ints.append(signed(x))
                 else:
-                    ints.append(v)
+                    ints.append(signed(v))
             return ints[0] if len(ints) == 1 else ints
     return None
 

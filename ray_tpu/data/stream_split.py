@@ -23,26 +23,33 @@ class SplitCoordinator:
         self._ds = dataset
         self._n = n
         self._equal = equal
-        self._epoch = -1
-        self._splits: List[List[Tuple]] = []
+        # epoch -> splits; kept until every rank fetched its split so a
+        # fast rank starting epoch k+1 can't clobber a slow rank's epoch k.
+        self._epochs: Dict[int, List[List[Tuple]]] = {}
+        self._fetched: Dict[int, set] = {}
         self._lock = threading.Lock()
 
-    def _start_epoch(self, epoch: int) -> None:
+    def _start_epoch(self, epoch: int) -> List[List[Tuple]]:
         ds = self._ds.repartition(self._n) if self._equal else self._ds
         bundles = list(ds.iter_internal_ref_bundles())
         splits: List[List[Tuple]] = [[] for _ in range(self._n)]
         for i, b in enumerate(bundles):
             splits[i % self._n].append(b)
-        self._splits = splits
-        self._epoch = epoch
+        return splits
 
     def get_split(self, rank: int, epoch: int) -> List[Tuple]:
-        """Blocking epoch barrier: first caller of a new epoch triggers
-        execution; all ranks then read the same epoch's split."""
+        """First caller of an epoch triggers execution; every rank reads
+        that same epoch's split exactly once."""
         with self._lock:
-            if epoch > self._epoch:
-                self._start_epoch(epoch)
-        return self._splits[rank]
+            if epoch not in self._epochs:
+                self._epochs[epoch] = self._start_epoch(epoch)
+                self._fetched[epoch] = set()
+            split = self._epochs[epoch][rank]
+            self._fetched[epoch].add(rank)
+            if len(self._fetched[epoch]) == self._n:
+                del self._epochs[epoch]
+                del self._fetched[epoch]
+            return split
 
 
 class SplitDataIterator(DataIterator):

@@ -47,6 +47,9 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    #: Tune stop criteria, e.g. {"training_iteration": 10} (reference:
+    #: air RunConfig.stop).
+    stop: Optional[Dict[str, Any]] = None
 
 
 @dataclass

@@ -228,6 +228,44 @@ def test_tensor_shape_preserved(cluster):
     assert ds2.take_batch(4)["item"].shape == (4, 2, 3)
 
 
+def test_empty_after_filter_pipelines(cluster):
+    # fns must not be called on schema-less emptied blocks; sort/shuffle
+    # of all-empty data must not crash
+    ds = (
+        rd.range(10, override_num_blocks=3)
+        .filter(lambda r: False)
+        .map_batches(lambda b: {"y": b["id"] * 2})
+    )
+    assert ds.count() == 0
+    assert rd.range(10, override_num_blocks=3).filter(
+        lambda r: False
+    ).sort("id").count() == 0
+
+
+def test_tfrecord_negative_ints(cluster, tmp_path):
+    ds = rd.from_items([{"x": -1}, {"x": -(2 ** 40)}, {"x": 7}])
+    tdir = str(tmp_path / "neg")
+    ds.write_tfrecords(tdir)
+    vals = sorted(r["x"] for r in rd.read_tfrecords(tdir).take_all())
+    assert vals == [-(2 ** 40), -1, 7]
+
+
+def test_streaming_split_epoch_isolation(cluster):
+    # a fast rank advancing epochs must not clobber a slow rank's epoch
+    its = rd.range(20, override_num_blocks=2).streaming_split(2)
+    fast, slow = its
+    fast_e0 = [v for b in fast.iter_batches(batch_size=None)
+               for v in b["id"].tolist()]
+    # fast rank starts epoch 1 before the slow rank ever read epoch 0
+    fast_e1 = [v for b in fast.iter_batches(batch_size=None)
+               for v in b["id"].tolist()]
+    slow_e0 = [v for b in slow.iter_batches(batch_size=None)
+               for v in b["id"].tolist()]
+    # epoch-0 halves must still cover the full dataset exactly
+    assert sorted(fast_e0 + slow_e0) == list(range(20))
+    assert len(fast_e1) == 10
+
+
 def test_columns_ops(cluster):
     ds = rd.range(5).add_column("two", lambda b: b["id"] * 2)
     assert ds.take(1) == [{"id": 0, "two": 0}]

@@ -1,0 +1,189 @@
+"""ray_tpu.tune tests (reference strategy: tune/tests with mock
+trainables and deterministic search spaces)."""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _quadratic(config):
+    # max of -(x-3)^2 at x=3
+    for i in range(5):
+        tune.report({"score": -((config["x"] - 3.0) ** 2) - 0.01 * (5 - i)})
+
+
+def test_grid_search(cluster, tmp_path):
+    results = tune.Tuner(
+        _quadratic,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path),
+                                           name="grid"),
+    ).fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.metrics["config/x"] if "config/x" in best.metrics else True
+    assert abs(best.metrics["score"]) < 0.1
+
+
+def test_random_search_num_samples(cluster, tmp_path):
+    results = tune.Tuner(
+        _quadratic,
+        param_space={"x": tune.uniform(0, 6)},
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=6),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path),
+                                           name="rand"),
+    ).fit()
+    assert len(results) == 6
+    assert not results.errors
+
+
+def test_trainable_class_and_checkpointing(cluster, tmp_path):
+    class Counter(tune.Trainable):
+        def setup(self, config):
+            self.total = 0
+            self.inc = config["inc"]
+
+        def step(self):
+            self.total += self.inc
+            return {"total": self.total}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "state.txt"), "w") as f:
+                f.write(str(self.total))
+            return d
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "state.txt")) as f:
+                self.total = int(f.read())
+
+    rc = ray_tpu.train.RunConfig(storage_path=str(tmp_path), name="cls",
+                                 stop={"training_iteration": 4})
+    results = tune.Tuner(
+        Counter,
+        param_space={"inc": tune.grid_search([1, 10])},
+        tune_config=tune.TuneConfig(metric="total", mode="max"),
+        run_config=rc,
+    ).fit()
+    assert len(results) == 2
+    best = results.get_best_result()
+    assert best.metrics["total"] == 40
+    assert best.checkpoint is not None
+    with open(os.path.join(best.checkpoint.path, "state.txt")) as f:
+        assert f.read() == "40"
+
+
+def test_asha_stops_bad_trials(cluster, tmp_path):
+    def trainable(config):
+        for i in range(1, 17):
+            tune.report({"acc": config["q"] * i})
+
+    # Strong trials first + sequential execution so rung cutoffs are
+    # established before weak trials arrive (async ASHA never stops the
+    # first arrival at a rung).
+    sched = tune.ASHAScheduler(grace_period=2, reduction_factor=2, max_t=16)
+    results = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([1.0, 0.5, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(metric="acc", mode="max",
+                                    scheduler=sched,
+                                    max_concurrent_trials=1),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path),
+                                           name="asha"),
+    ).fit()
+    iters = sorted(
+        len(r.metrics_history) for r in results.results
+    )
+    # at least one trial early-stopped, and the best survived longer
+    assert iters[0] < 16
+    best = results.get_best_result()
+    assert best.metrics["acc"] == pytest.approx(16.0)
+
+
+def test_pbt_exploits(cluster, tmp_path):
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        start = 0
+        if ckpt:
+            with open(os.path.join(ckpt.path, "v.txt")) as f:
+                start = int(f.read())
+        import tempfile
+
+        for i in range(start + 1, 21):
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "v.txt"), "w") as f:
+                f.write(str(i))
+            tune.report(
+                {"perf": config["lr"] * i, "training_iteration": i},
+                checkpoint=ray_tpu.train.Checkpoint(d),
+            )
+
+    sched = tune.PopulationBasedTraining(
+        perturbation_interval=5,
+        hyperparam_mutations={"lr": [0.1, 1.0, 10.0]},
+        seed=0,
+    )
+    results = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 10.0])},
+        tune_config=tune.TuneConfig(metric="perf", mode="max", scheduler=sched,
+                                    max_concurrent_trials=2),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path),
+                                           name="pbt"),
+    ).fit()
+    assert len(results) == 2
+    # the weak trial should have been exploited to a strong lr at least once
+    assert all(r.metrics["perf"] > 2.0 for r in results.results)
+
+
+def test_failed_trial_reports_error(cluster, tmp_path):
+    def bad(config):
+        tune.report({"x": 1})
+        raise ValueError("boom")
+
+    results = tune.Tuner(
+        bad,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="x", mode="max"),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path),
+                                           name="fail"),
+    ).fit()
+    assert len(results.errors) == 1
+
+
+def test_experiment_state_saved(cluster, tmp_path):
+    results = tune.Tuner(
+        _quadratic,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=ray_tpu.train.RunConfig(storage_path=str(tmp_path),
+                                           name="state"),
+    ).fit()
+    state_file = tmp_path / "state" / "experiment_state.json"
+    assert state_file.exists()
+    import json
+
+    state = json.loads(state_file.read_text())
+    assert len(state["trials"]) == 2
+    assert all(t["status"] == "TERMINATED" for t in state["trials"])
+
+
+def test_tune_run_functional(cluster, tmp_path):
+    results = tune.run(
+        _quadratic,
+        config={"x": tune.grid_search([2.0, 3.0])},
+        metric="score",
+        mode="max",
+        storage_path=str(tmp_path),
+        name="func",
+    )
+    assert len(results) == 2
