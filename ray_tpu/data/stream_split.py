@@ -49,6 +49,11 @@ class SplitCoordinator:
             if len(self._fetched[epoch]) == self._n:
                 del self._epochs[epoch]
                 del self._fetched[epoch]
+            # Bound retention: if a rank died / stopped iterating, old
+            # epochs would otherwise pin their ref bundles forever.
+            for old in [e for e in self._epochs if e < epoch - 1]:
+                del self._epochs[old]
+                del self._fetched[old]
             return split
 
 

@@ -76,8 +76,18 @@ class ActorDiedError(RayActorError):
     pass
 
 
+class ActorUnavailableError(RayActorError):
+    """The actor is temporarily unreachable (reference:
+    exceptions.py ActorUnavailableError); the call may be retried."""
+
+
 class ActorUnschedulableError(RayTpuError):
     pass
+
+
+class BackPressureError(RayTpuError):
+    """Too many queued requests (reference: serve
+    BackPressureError) — the caller should shed load or retry later."""
 
 
 class WorkerCrashedError(RayTpuError):

@@ -1,0 +1,57 @@
+"""ray_tpu.serve: scalable model serving on the actor substrate.
+
+Architecture mirrors the reference (python/ray/serve — see SURVEY.md
+§3.5): a ServeController actor owns application/deployment state and
+reconciles replica actors toward the target; per-node ProxyActors serve
+HTTP (aiohttp here, uvicorn/starlette in the reference); handles embed a
+Router using power-of-two-choices replica scheduling
+(serve/_private/replica_scheduler/pow_2_scheduler.py:49); config is
+pushed via a long-poll host (serve/_private/long_poll.py:173).
+
+TPU-native notes: replicas are ordinary ray_tpu actors, so a deployment
+can hold a jitted jax model (compiled once per replica process) and
+batched requests ride the MXU via `@serve.batch`.
+"""
+from __future__ import annotations
+
+from .api import (  # noqa: F401
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    get_multiplexed_model_id,
+    get_replica_context,
+    ingress,
+    multiplexed,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .batching import batch  # noqa: F401
+from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
+from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPOptions",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "get_replica_context",
+    "ingress",
+    "multiplexed",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,124 @@
+"""DeploymentHandle: the composition API for calling deployments.
+
+Reference: python/ray/serve/handle.py (_DeploymentHandleBase:104,
+DeploymentResponse:456). A handle embeds a Router (power-of-two-choices
+over live replicas); ``handle.method.remote(*args)`` returns a
+DeploymentResponse that can be awaited, resolved with ``.result()``, or
+passed directly as an argument to another handle call (model
+composition).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import uuid
+from typing import Any, Optional
+
+from ._private.common import DeploymentID, RequestMetadata
+
+
+class DeploymentResponse:
+    def __init__(self, future: "concurrent.futures.Future"):
+        self._future = future
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return self._future.result(timeout=timeout_s)
+
+    def cancel(self):
+        self._future.cancel()
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self._future).__await__()
+
+
+class _MethodProxy:
+    def __init__(self, handle: "DeploymentHandle", method_name: str):
+        self._handle = handle
+        self._method = method_name
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._remote(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(
+        self,
+        deployment_name: str,
+        app_name: str = "default",
+        *,
+        method_name: str = "__call__",
+        multiplexed_model_id: str = "",
+        _is_http: bool = False,
+    ):
+        self.deployment_id = DeploymentID(deployment_name, app_name)
+        self._method_name = method_name
+        self._multiplexed_model_id = multiplexed_model_id
+        self._is_http = _is_http
+        self._router = None
+
+    # ------------------------------------------------------------ options
+    def options(
+        self,
+        *,
+        method_name: Optional[str] = None,
+        multiplexed_model_id: Optional[str] = None,
+    ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_id.name,
+            self.deployment_id.app_name,
+            method_name=method_name or self._method_name,
+            multiplexed_model_id=(
+                multiplexed_model_id
+                if multiplexed_model_id is not None
+                else self._multiplexed_model_id
+            ),
+            _is_http=self._is_http,
+        )
+
+    def __getattr__(self, name: str) -> _MethodProxy:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodProxy(self, name)
+
+    # ------------------------------------------------------------- calls
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._remote(self._method_name, args, kwargs)
+
+    def _remote(self, method_name: str, args, kwargs) -> DeploymentResponse:
+        from ._private.router import get_or_create_router
+
+        if self._router is None:
+            self._router = get_or_create_router(self.deployment_id)
+        meta = RequestMetadata(
+            request_id=uuid.uuid4().hex,
+            call_method=method_name,
+            multiplexed_model_id=self._multiplexed_model_id,
+            http_request=self._is_http,
+        )
+        return DeploymentResponse(self._router.assign_request(meta, args, kwargs))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.deployment_id})"
+
+    def __reduce__(self):
+        return (
+            _rebuild_handle,
+            (
+                self.deployment_id.name,
+                self.deployment_id.app_name,
+                self._method_name,
+                self._multiplexed_model_id,
+            ),
+        )
+
+
+def _rebuild_handle(name, app_name, method_name, multiplexed_model_id):
+    return DeploymentHandle(
+        name,
+        app_name,
+        method_name=method_name,
+        multiplexed_model_id=multiplexed_model_id,
+    )
+
+

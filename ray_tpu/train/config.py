@@ -61,3 +61,4 @@ class Result:
     error: Optional[BaseException]
     path: Optional[str]
     metrics_history: list = field(default_factory=list)
+    config: Optional[Dict[str, Any]] = None

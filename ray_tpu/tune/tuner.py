@@ -46,6 +46,7 @@ class ResultGrid:
                 error=RuntimeError(t.error) if t.error else None,
                 path=t.local_dir,
                 metrics_history=t.metrics_history,
+                config=dict(t.config),
             )
             for t in self._trials
         ]
@@ -111,10 +112,27 @@ class Tuner:
         self._restore_path = _restore_path
 
     @classmethod
-    def restore(cls, path: str, trainable) -> "Tuner":
+    def restore(
+        cls,
+        path: str,
+        trainable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ) -> "Tuner":
         """Resume an interrupted experiment from its state file
-        (reference: Tuner.restore)."""
-        return cls(trainable, _restore_path=path)
+        (reference: Tuner.restore). param_space/tune_config/run_config
+        must match the original run; pass them again so restored
+        PENDING trials keep their search space, metric, and stop
+        criteria."""
+        return cls(
+            trainable,
+            param_space=param_space,
+            tune_config=tune_config,
+            run_config=run_config,
+            _restore_path=path,
+        )
 
     def fit(self) -> ResultGrid:
         exp_dir = self._restore_path or _default_experiment_dir(

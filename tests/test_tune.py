@@ -31,7 +31,7 @@ def test_grid_search(cluster, tmp_path):
     ).fit()
     assert len(results) == 4
     best = results.get_best_result()
-    assert best.metrics["config/x"] if "config/x" in best.metrics else True
+    assert best.config["x"] == 3.0
     assert abs(best.metrics["score"]) < 0.1
 
 
