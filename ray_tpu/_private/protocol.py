@@ -90,6 +90,14 @@ class PeerConn:
                     self._push_handler(msg)
         except (EOFError, OSError, BrokenPipeError):
             pass
+        except TypeError:
+            # Interpreter teardown: multiprocessing's read() gets a None
+            # handle when the connection closes mid-recv at exit. A
+            # TypeError during normal operation is a real bug — re-raise.
+            import sys
+
+            if not sys.is_finalizing():
+                raise
         finally:
             self._closed.set()
             with self._pending_lock:

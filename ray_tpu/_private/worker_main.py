@@ -89,6 +89,12 @@ class WorkerRuntime:
                 self._done.set()
                 self.task_queue.put(None)
                 return None
+            if spec.method_name == "__ray_apply__":
+                # Apply a shipped function to the actor instance
+                # (compiled-graph loops, introspection) — the function
+                # runs with actor state but isn't a class method.
+                fn = cloudpickle.loads(args[0])
+                return fn(self.actor_instance, *args[1:], **kwargs)
             method = getattr(self.actor_instance, spec.method_name)
             return method(*args, **kwargs)
         fn = self._resolve_function(spec)

@@ -45,6 +45,12 @@ class ActorMethod:
             self._handle, self._method_name, num_returns or self._num_returns
         )
 
+    def bind(self, *args, **kwargs):
+        """Lazy actor-method call node for DAGs / compiled graphs."""
+        from .dag import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         client = global_client()
         args_blob, deps = _submit.prepare_args(args, kwargs)
@@ -76,6 +82,10 @@ class ActorHandle:
         self._class_function_id = class_function_id
 
     def __getattr__(self, name: str) -> ActorMethod:
+        if name == "__ray_apply__":
+            # Framework-internal: apply a shipped function to the actor
+            # instance (compiled-graph loops) — see worker_main.
+            return ActorMethod(self, "__ray_apply__")
         if name.startswith("_"):
             raise AttributeError(name)
         return ActorMethod(self, name)

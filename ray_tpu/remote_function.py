@@ -51,6 +51,14 @@ class RemoteFunction:
         clone._function_id = self._function_id
         return clone
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of executing (reference:
+        ray.dag — dag_node.py); run with ``.execute()`` or hand to
+        ``workflow.run``."""
+        from .dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def _ensure_pickled(self):
         if self._blob is None:
             self._blob = _submit.pickle_by_value(self._fn)

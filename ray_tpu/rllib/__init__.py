@@ -1,0 +1,27 @@
+"""ray_tpu.rllib: reinforcement learning on the actor substrate.
+
+Architecture mirrors the reference's new API stack (rllib/ — SURVEY.md
+§2.4): `EnvRunnerGroup` of CPU actors sampling gymnasium vector envs,
+connector pipelines between env and module, flax `RLModule`s replacing
+torch ModelV2/Policy, and a `Learner`/`LearnerGroup` whose update is a
+single jitted jax program — on TPU the gradient step (and any
+data-parallel mean) compiles into one XLA program over the device mesh
+instead of DDP/NCCL.
+
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2)
+        .build()
+    )
+    for _ in range(10):
+        result = algo.train()
+"""
+from __future__ import annotations
+
+from .core.rl_module import RLModule, RLModuleSpec  # noqa: F401
+from .env.episode import SingleAgentEpisode  # noqa: F401
+
+__all__ = ["RLModule", "RLModuleSpec", "SingleAgentEpisode"]

@@ -1,0 +1,153 @@
+"""AlgorithmConfig: fluent builder for RL algorithms.
+
+Reference: rllib/algorithms/algorithm_config.py — chained
+``.environment().env_runners().training().build()``. Each algorithm
+subclasses it with algorithm-specific training knobs.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Type, Union
+
+from ..core.rl_module import DiscretePolicyModule, RLModuleSpec
+
+
+class AlgorithmConfig:
+    algo_class: Optional[type] = None
+    default_module_class: type = DiscretePolicyModule
+
+    def __init__(self):
+        # environment
+        self.env: Union[str, Callable, None] = None
+        self.env_config: Dict[str, Any] = {}
+        # env runners
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 1
+        self.num_cpus_per_env_runner = 1
+        self.rollout_fragment_length = 200
+        # training
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 4000
+        self.minibatch_size: Optional[int] = None
+        self.num_epochs = 1
+        self.grad_clip: Optional[float] = None
+        # learners
+        self.num_learners = 0
+        self.num_cpus_per_learner = 1
+        self.num_tpus_per_learner = 0
+        self.num_devices_per_learner = 1
+        # module
+        self.module_class: Optional[type] = None
+        self.model_config: Dict[str, Any] = {}
+        # misc
+        self.seed: Optional[int] = None
+
+    # ----------------------------------------------------------- builder
+    def environment(self, env=None, *, env_config=None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(
+        self,
+        *,
+        num_env_runners=None,
+        num_envs_per_env_runner=None,
+        num_cpus_per_env_runner=None,
+        rollout_fragment_length=None,
+    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def learners(
+        self,
+        *,
+        num_learners=None,
+        num_cpus_per_learner=None,
+        num_tpus_per_learner=None,
+        num_devices_per_learner=None,
+    ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_cpus_per_learner is not None:
+            self.num_cpus_per_learner = num_cpus_per_learner
+        if num_tpus_per_learner is not None:
+            self.num_tpus_per_learner = num_tpus_per_learner
+        if num_devices_per_learner is not None:
+            self.num_devices_per_learner = num_devices_per_learner
+        return self
+
+    def rl_module(self, *, module_class=None, model_config=None) -> "AlgorithmConfig":
+        if module_class is not None:
+            self.module_class = module_class
+        if model_config is not None:
+            self.model_config = dict(model_config)
+        return self
+
+    def debugging(self, *, seed=None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # ------------------------------------------------------------- build
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def module_spec(self, observation_space=None, action_space=None) -> RLModuleSpec:
+        return RLModuleSpec(
+            module_class=self.module_class or self.default_module_class,
+            observation_space=observation_space,
+            action_space=action_space,
+            model_config=dict(self.model_config),
+        )
+
+    def env_runner_config(self, module_spec) -> Dict[str, Any]:
+        return {
+            "env": self.env,
+            "env_config": self.env_config,
+            "num_env_runners": self.num_env_runners,
+            "num_envs_per_env_runner": self.num_envs_per_env_runner,
+            "num_cpus_per_env_runner": self.num_cpus_per_env_runner,
+            "rollout_fragment_length": self.rollout_fragment_length,
+            "module_spec": module_spec,
+            "seed": self.seed,
+        }
+
+    def learner_config(self) -> Dict[str, Any]:
+        return {
+            "lr": self.lr,
+            "gamma": self.gamma,
+            "minibatch_size": self.minibatch_size,
+            "num_epochs": self.num_epochs,
+            "grad_clip": self.grad_clip,
+            "num_learners": self.num_learners,
+            "num_cpus_per_learner": self.num_cpus_per_learner,
+            "num_tpus_per_learner": self.num_tpus_per_learner,
+            "num_devices_per_learner": self.num_devices_per_learner,
+            "seed": self.seed,
+        }
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.__dict__.items()}
+
+    def build(self):
+        if self.algo_class is None:
+            raise ValueError(f"{type(self).__name__}.algo_class not set")
+        return self.algo_class(config=self)

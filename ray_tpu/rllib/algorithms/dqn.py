@@ -1,0 +1,248 @@
+"""DQN / double-DQN with (prioritized) replay.
+
+Reference: rllib/algorithms/dqn/ — sample with ε-greedy exploration
+into a replay buffer; update on uniform or PER samples with a target
+network refreshed every N steps; double-Q action selection by the
+online net.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..connectors.connector_v2 import (
+    BatchObservations,
+    ConnectorPipelineV2,
+    EpsilonGreedyActions,
+)
+from ..core.learner import Learner
+from ..core.rl_module import Columns, QNetworkModule
+from ..utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+class DQNConfig(AlgorithmConfig):
+    default_module_class = QNetworkModule
+
+    def __init__(self):
+        super().__init__()
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.replay_buffer_capacity = 50_000
+        self.prioritized_replay = False
+        self.per_alpha = 0.6
+        self.per_beta = 0.4
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500
+        self.double_q = True
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 10_000
+        self.sample_timesteps_per_iteration = 400
+        self.updates_per_iteration = 100
+
+    @property
+    def algo_class(self):
+        return DQN
+
+    def learner_config(self):
+        cfg = super().learner_config()
+        cfg.update(
+            gamma=self.gamma,
+            double_q=self.double_q,
+            # minibatching handled by replay sampling
+            minibatch_size=None,
+            num_epochs=1,
+            target_updates_every=max(
+                1,
+                self.target_network_update_freq
+                // max(1, self.train_batch_size),
+            ),
+        )
+        return cfg
+
+
+class DQNLearner(Learner):
+    def build(self):
+        super().build()
+        import jax
+
+        self.target_params = jax.device_get(self.params)
+        self._updates = 0
+
+    def build_batch(self, episodes):
+        from ..connectors.connector_v2 import EpisodesToBatch
+
+        return EpisodesToBatch()(episodes=episodes)
+
+    def compute_loss(self, params, batch, rng) -> Tuple[Any, Dict[str, Any]]:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        q_all = self.module.forward_train(params, batch)["q_values"]
+        actions = batch[Columns.ACTIONS].astype(jnp.int32)
+        q = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
+
+        # Target params ride in the batch as a jit argument (a captured
+        # self.target_params would bake into the compiled program and
+        # force a recompile at every target sync).
+        next_batch = {Columns.OBS: batch[Columns.NEXT_OBS]}
+        q_next_target = self.module.forward_train(
+            batch["target_params"], next_batch
+        )["q_values"]
+        if cfg.get("double_q", True):
+            q_next_online = self.module.forward_train(params, next_batch)[
+                "q_values"
+            ]
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[
+                :, 0
+            ]
+        else:
+            q_next = jnp.max(q_next_target, axis=-1)
+        q_next = jax_stop_gradient(q_next)
+        target = (
+            batch[Columns.REWARDS]
+            + cfg["gamma"] * (1.0 - batch[Columns.TERMINATEDS]) * q_next
+        )
+        td = q - target
+        weights = batch.get("weights")
+        loss = jnp.mean(
+            (weights if weights is not None else 1.0) * huber(td)
+        )
+        return loss, {"qf_mean": jnp.mean(q), "td_error_abs": jnp.mean(jnp.abs(td))}
+
+    def update(self, batch):
+        # Refresh target net on schedule (counted in update calls).
+        batch = dict(batch, target_params=self.target_params)
+        metrics = super().update(batch)
+        self._updates += 1
+        if self._updates % max(
+            1, self.config.get("target_updates_every", 10)
+        ) == 0:
+            import jax
+
+            self.target_params = jax.device_get(self.params)
+        return metrics
+
+    def td_errors(self, batch) -> np.ndarray:
+        """|TD| per transition for PER priority updates."""
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(self, "_td_jit"):
+
+            def f(params, target_params, batch):
+                q_all = self.module.forward_train(params, batch)["q_values"]
+                actions = batch[Columns.ACTIONS].astype(jnp.int32)
+                q = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
+                nb = {Columns.OBS: batch[Columns.NEXT_OBS]}
+                qt = self.module.forward_train(target_params, nb)["q_values"]
+                qn = jnp.max(qt, axis=-1)
+                target = (
+                    batch[Columns.REWARDS]
+                    + self.config["gamma"]
+                    * (1.0 - batch[Columns.TERMINATEDS])
+                    * qn
+                )
+                return jnp.abs(q - target)
+
+            self._td_jit = jax.jit(f)
+        return np.asarray(
+            jax.device_get(
+                self._td_jit(self.params, self.target_params, batch)
+            )
+        )
+
+
+class _EpsilonSchedule(EpsilonGreedyActions):
+    """Linear ε decay; picklable (lambdas can't ship to runner actors)."""
+
+    def __init__(self, eps0: float, eps1: float, horizon: int):
+        self.eps0, self.eps1, self.horizon = eps0, eps1, horizon
+        super().__init__(self._eps)
+
+    def _eps(self, step: int) -> float:
+        return max(
+            self.eps1,
+            self.eps0 - (self.eps0 - self.eps1) * step / self.horizon,
+        )
+
+
+def jax_stop_gradient(x):
+    import jax
+
+    return jax.lax.stop_gradient(x)
+
+
+def huber(x, delta: float = 1.0):
+    import jax.numpy as jnp
+
+    ax = jnp.abs(x)
+    return jnp.where(ax <= delta, 0.5 * x * x, delta * (ax - 0.5 * delta))
+
+
+class DQN(Algorithm):
+    learner_class = DQNLearner
+
+    def setup(self, config_dict) -> None:
+        super().setup(config_dict)
+        cfg = self.config
+        if cfg.prioritized_replay:
+            self.replay = PrioritizedReplayBuffer(
+                cfg.replay_buffer_capacity,
+                alpha=cfg.per_alpha,
+                beta=cfg.per_beta,
+                seed=cfg.seed,
+            )
+        else:
+            self.replay = ReplayBuffer(cfg.replay_buffer_capacity, seed=cfg.seed)
+
+    def env_runner_config(self) -> Dict[str, Any]:
+        # ε-greedy exploration schedule lives in the runner's
+        # module-to-env connector.
+        cfg = self.config
+        eps0, eps1, T = (
+            cfg.epsilon_initial,
+            cfg.epsilon_final,
+            cfg.epsilon_timesteps,
+        )
+        runner_cfg = super().env_runner_config()
+        runner_cfg["module_to_env"] = ConnectorPipelineV2(
+            [_EpsilonSchedule(eps0, eps1, T)]
+        )
+        return runner_cfg
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        episodes = self.env_runner_group.sample(
+            num_timesteps=cfg.sample_timesteps_per_iteration
+        )
+        self._record_episodes(episodes)
+        self.replay.add_episodes(episodes)
+        if len(self.replay) < cfg.num_steps_sampled_before_learning_starts:
+            return {"buffer_size": float(len(self.replay))}
+        metrics_list = []
+        assert self.learner_group.is_local, (
+            "DQN uses a local learner (replay lives with the algorithm)"
+        )
+        learner: DQNLearner = self.learner_group._local
+        for _ in range(cfg.updates_per_iteration):
+            batch = self.replay.sample(cfg.train_batch_size)
+            idx = batch.pop("batch_indexes")
+            m = learner.update({k: v for k, v in batch.items()})
+            if cfg.prioritized_replay:
+                self.replay.update_priorities(
+                    idx, learner.td_errors(batch)
+                )
+            metrics_list.append(m)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        out = {
+            k: float(np.mean([m[k] for m in metrics_list]))
+            for k in metrics_list[0]
+        }
+        out["buffer_size"] = float(len(self.replay))
+        return out

@@ -1,0 +1,283 @@
+"""IMPALA: asynchronous sampling + V-trace off-policy correction.
+
+Reference: rllib/algorithms/impala/impala.py:530 — env runners sample
+continuously (no barrier with the learner); sample batches carry the
+behavior policy's logp, and the learner corrects the policy lag with
+V-trace (Espeholt et al. 2018) clipped importance weights. The learner
+update is one jitted program; the V-trace recursion is a
+``lax.scan`` over time (XLA-friendly — no python loop over T).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+from ..core.learner import Learner
+from ..core.rl_module import Columns
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        # Defaults follow the reference's tuned CartPole config
+        # (rllib/tuned_examples/impala/cartpole_impala.py): small vf
+        # coefficient and global-norm grad clipping are what keep
+        # V-trace from collapsing the policy early.
+        self.lr = 5e-4
+        self.train_batch_size = 500
+        self.grad_clip = 40.0
+        self.vf_loss_coeff = 0.05
+        self.entropy_coeff = 0.005
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_c_threshold = 1.0
+        # Standardize PG advantages within the batch. Not in the
+        # original V-trace, but it prevents the early positive-feedback
+        # policy collapse when every reward is positive and the value
+        # net hasn't converged yet.
+        self.standardize_advantages = True
+        self.rollout_fragment_length = 50
+        self.num_env_runners = 2
+        self.max_requests_in_flight_per_env_runner = 2
+        self.broadcast_interval = 1  # sync weights every N learner steps
+
+    @property
+    def algo_class(self):
+        return IMPALA
+
+    def learner_config(self):
+        cfg = super().learner_config()
+        cfg.update(
+            gamma=self.gamma,
+            vf_loss_coeff=self.vf_loss_coeff,
+            entropy_coeff=self.entropy_coeff,
+            vtrace_clip_rho_threshold=self.vtrace_clip_rho_threshold,
+            vtrace_clip_c_threshold=self.vtrace_clip_c_threshold,
+            rollout_fragment_length=self.rollout_fragment_length,
+            standardize_advantages=self.standardize_advantages,
+        )
+        return cfg
+
+
+def _pad_episodes(episodes, T: int):
+    """Episodes → [B, T] padded arrays + mask (static shapes for XLA)."""
+    cols = {
+        "obs": [],
+        "actions": [],
+        "rewards": [],
+        "terminateds": [],
+        "action_logp": [],
+        "bootstrap_obs": [],
+        "mask": [],
+    }
+    for ep in episodes:
+        L = min(len(ep), T)
+        obs = np.asarray(ep.observations, np.float32)
+        pad = T - L
+        cols["obs"].append(
+            np.concatenate([obs[:L], np.zeros((pad,) + obs.shape[1:], np.float32)])
+        )
+        cols["bootstrap_obs"].append(obs[L])
+        acts = np.asarray(ep.actions[:L])
+        cols["actions"].append(np.concatenate([acts, np.zeros(pad, acts.dtype)]))
+        rew = np.asarray(ep.rewards[:L], np.float32)
+        cols["rewards"].append(np.concatenate([rew, np.zeros(pad, np.float32)]))
+        term = np.zeros(T, np.float32)
+        if ep.is_terminated and L == len(ep):
+            term[L - 1] = 1.0
+        cols["terminateds"].append(term)
+        logp = np.asarray(ep.extra_model_outputs["action_logp"][:L], np.float32)
+        cols["action_logp"].append(np.concatenate([logp, np.zeros(pad, np.float32)]))
+        mask = np.zeros(T, np.float32)
+        mask[:L] = 1.0
+        cols["mask"].append(mask)
+    return {k: np.stack(v) for k, v in cols.items()}
+
+
+class IMPALALearner(Learner):
+    def build(self):
+        super().build()
+        self.config.setdefault("minibatch_size", None)
+        self.config["num_epochs"] = 1
+
+    def build_batch(self, episodes) -> Dict[str, np.ndarray]:
+        batch = _pad_episodes(episodes, self.config["rollout_fragment_length"])
+        # Pad the batch dim to a multiple of 8 (mask=0 rows) so XLA sees
+        # a handful of shapes, not one compile per episode count.
+        B = len(episodes)
+        pad = (-B) % 8
+        if pad:
+            for k, v in batch.items():
+                batch[k] = np.concatenate(
+                    [v, np.zeros((pad,) + v.shape[1:], v.dtype)]
+                )
+        return batch
+
+    def compute_loss(self, params, batch, rng) -> Tuple[Any, Dict[str, Any]]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        B, T = batch["actions"].shape
+        obs_flat = batch["obs"].reshape((B * T,) + batch["obs"].shape[2:])
+        out = self.module.forward_train(params, {Columns.OBS: obs_flat})
+        logits = out[Columns.ACTION_DIST_INPUTS].reshape(B, T, -1)
+        values = out[Columns.VF_PREDS].reshape(B, T)
+        bootstrap = self.module.compute_values(params, batch["bootstrap_obs"])
+
+        z = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+        actions = batch["actions"].astype(jnp.int32)
+        target_logp = jnp.take_along_axis(z, actions[..., None], axis=-1)[..., 0]
+
+        mask = batch["mask"]
+        # The V-trace targets are pure *targets*: no gradient may flow
+        # through rho/c/bootstrap into the policy (rho = exp(pi - mu)
+        # carries d/d_logits even when numerically 1 on-policy; leaking
+        # it through the value loss silently corrupts the policy).
+        rho = jax.lax.stop_gradient(
+            jnp.exp(target_logp - batch["action_logp"])
+        )
+        rho_clip = jnp.minimum(rho, cfg["vtrace_clip_rho_threshold"])
+        c_clip = jnp.minimum(rho, cfg["vtrace_clip_c_threshold"])
+        bootstrap = jax.lax.stop_gradient(bootstrap)
+        discounts = cfg["gamma"] * (1.0 - batch["terminateds"]) * mask
+
+        values_stop = jax.lax.stop_gradient(values)
+        # next-step value: V(s_{t+1}) while t+1 is still a valid step of
+        # this chunk, else the bootstrap value V(s_L) (for rows shorter
+        # than T, position t+1 holds padding, not the next obs).
+        next_valid = jnp.concatenate(
+            [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1
+        )
+        v_shift = jnp.concatenate(
+            [values_stop[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1
+        )
+        v_tp1 = next_valid * v_shift + (1.0 - next_valid) * bootstrap[:, None]
+        deltas = mask * rho_clip * (
+            batch["rewards"] + discounts * v_tp1 - values_stop
+        )
+
+        def scan_fn(acc, xs):
+            delta_t, disc_t, c_t = xs
+            acc = delta_t + disc_t * c_t * acc
+            return acc, acc
+
+        # Reverse-time scan over T (time-major for scan).
+        _, acc = jax.lax.scan(
+            scan_fn,
+            jnp.zeros((B,), values.dtype),
+            (deltas.T, discounts.T, c_clip.T),
+            reverse=True,
+        )
+        vs = values_stop + acc.T
+        vs_shift = jnp.concatenate(
+            [vs[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1
+        )
+        vs_tp1 = next_valid * vs_shift + (1.0 - next_valid) * bootstrap[:, None]
+        pg_adv = jax.lax.stop_gradient(
+            rho_clip * (batch["rewards"] + discounts * vs_tp1 - values_stop)
+        )
+
+        denom = jnp.maximum(mask.sum(), 1.0)
+        if cfg.get("standardize_advantages", True):
+            adv_mean = jnp.sum(pg_adv * mask) / denom
+            adv_var = jnp.sum(jnp.square(pg_adv - adv_mean) * mask) / denom
+            pg_adv = (pg_adv - adv_mean) / jnp.maximum(
+                jnp.sqrt(adv_var), 1e-4
+            )
+        policy_loss = -jnp.sum(target_logp * pg_adv * mask) / denom
+        vf_loss = 0.5 * jnp.sum(jnp.square(vs - values) * mask) / denom
+        entropy = -jnp.sum(jnp.exp(z) * z * mask[..., None]) / denom
+        total = (
+            policy_loss
+            + cfg["vf_loss_coeff"] * vf_loss
+            - cfg["entropy_coeff"] * entropy
+        )
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.sum(rho * mask) / denom,
+        }
+
+
+class IMPALA(Algorithm):
+    learner_class = IMPALALearner
+
+    def setup(self, config_dict) -> None:
+        super().setup(config_dict)
+        self._inflight: Dict[Any, int] = {}  # ref -> actor index
+        self._learner_steps = 0
+        self._episode_buffer: List = []  # accumulate to train_batch_size
+        self._buffered_steps = 0
+
+    def _runner_sample_async(self, idx: int):
+        mgr = self.env_runner_group._manager
+        actor = mgr.actor(idx)
+        frag = self.config.rollout_fragment_length
+        n_envs = self.config.num_envs_per_env_runner
+        ref = actor.sample.remote(num_timesteps=frag * n_envs)
+        self._inflight[ref] = idx
+
+    def training_step(self) -> Dict[str, Any]:
+        if self.env_runner_group._manager is None:
+            # Synchronous degenerate mode (num_env_runners=0): still
+            # V-trace, but no async pipeline.
+            episodes = self.env_runner_group.sample(
+                num_timesteps=self.config.train_batch_size
+            )
+            self._record_episodes(episodes)
+            metrics = self.learner_group.update_from_episodes(episodes)
+            self.env_runner_group.sync_weights(self.learner_group.get_weights())
+            return metrics
+
+    # ---- async path: keep every runner busy; learn on arrival ----
+        mgr = self.env_runner_group._manager
+        in_flight_target = self.config.max_requests_in_flight_per_env_runner
+        for idx in mgr.healthy_actor_ids():
+            while (
+                sum(1 for i in self._inflight.values() if i == idx)
+                < in_flight_target
+            ):
+                self._runner_sample_async(idx)
+        ready, _ = ray_tpu.wait(
+            list(self._inflight), num_returns=1, timeout=60.0
+        )
+        all_metrics: List[Dict[str, Any]] = []
+        updated_runners = []
+        for ref in ready:
+            idx = self._inflight.pop(ref)
+            try:
+                episodes = ray_tpu.get(ref)
+            except Exception:  # runner died; manager will heal on next call
+                mgr._restart(idx)
+                continue
+            self._record_episodes(episodes)
+            self._episode_buffer.extend(episodes)
+            self._buffered_steps += sum(len(e) for e in episodes)
+            if self._buffered_steps >= self.config.train_batch_size:
+                all_metrics.append(
+                    self.learner_group.update_from_episodes(
+                        self._episode_buffer
+                    )
+                )
+                self._episode_buffer = []
+                self._buffered_steps = 0
+                self._learner_steps += 1
+            updated_runners.append(idx)
+            self._runner_sample_async(idx)
+        if all_metrics and self._learner_steps % self.config.broadcast_interval == 0:
+            w_ref = ray_tpu.put(self.learner_group.get_weights())
+            for idx in set(updated_runners):
+                mgr.actor(idx).set_weights.remote(w_ref)
+        if not all_metrics:
+            return {}
+        return {
+            k: float(np.mean([m[k] for m in all_metrics]))
+            for k in all_metrics[0]
+        }

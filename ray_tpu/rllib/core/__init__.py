@@ -1,0 +1,1 @@
+DEFAULT_MODULE_ID = "default_policy"

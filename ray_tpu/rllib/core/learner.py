@@ -1,0 +1,260 @@
+"""Learner: owns params + optimizer, runs the jitted update.
+
+Reference: rllib/core/learner/learner.py + torch_learner.py:56. The
+TPU-first inversion: instead of torch DDP across learner processes, the
+whole gradient step is ONE jitted jax program; data parallelism over
+local chips compiles into the same program via a `data`-axis mesh
+(XLA inserts the gradient psum over ICI). Multi-process learners (one
+per TPU host) still work by out-of-graph gradient allreduce through
+ray_tpu.util.collective — that's the DCN path, used only when a single
+mesh can't span the learners.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Learner:
+    """Subclasses implement ``compute_loss(params, batch, rng)``."""
+
+    def __init__(self, *, module_spec, config: Dict[str, Any]):
+        self._module_spec = module_spec
+        self.config = dict(config)
+        self.module = None
+        self.params = None
+        self.opt_state = None
+        self._tx = None
+        self._jit_update = None
+        self._rng = None
+        self._collective_group: Optional[str] = None
+        self._mesh = None
+
+    # ------------------------------------------------------------- build
+    def build(self) -> None:
+        import jax
+        import optax
+
+        self.module = self._module_spec.build()
+        seed = int(self.config.get("seed") or 0)
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng, init_rng = jax.random.split(self._rng)
+        if self.config.get("num_devices_per_learner", 1) > 1:
+            from ...parallel import MeshSpec
+
+            n = self.config["num_devices_per_learner"]
+            self._mesh = MeshSpec(data=n).build()
+        self.params = self.module.init_params(init_rng)
+        self._np_rng = np.random.default_rng(seed)
+        lr = self.config.get("lr", 3e-4)
+        clip = self.config.get("grad_clip")
+        chain = []
+        if clip:
+            chain.append(optax.clip_by_global_norm(clip))
+        chain.append(optax.adam(lr))
+        self._tx = optax.chain(*chain)
+        self.opt_state = self._tx.init(self.params)
+
+    # -------------------------------------------------------------- loss
+    def compute_loss(
+        self, params, batch: Dict[str, Any], rng
+    ) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ update
+    def _make_update_fn(self):
+        import jax
+
+        tx = self._tx
+
+        def update_step(params, opt_state, batch, rng):
+            def loss_fn(p):
+                return self.compute_loss(p, batch, rng)
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            import optax
+
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        return update_step
+
+    def _ensure_jit(self):
+        import jax
+
+        if self._jit_update is None:
+            fn = self._make_update_fn()
+            if self._collective_group:
+                fn = self._wrap_collective(fn)
+                self._jit_update = fn  # allreduce is out-of-graph
+            else:
+                self._jit_update = jax.jit(fn, donate_argnums=(0, 1))
+
+    def _wrap_collective(self, update_fn):
+        """Out-of-graph gradient averaging across learner processes
+        (DCN path). Gradients are computed jitted, allreduced via the
+        collective API, then applied jitted."""
+        import jax
+        import optax
+
+        group = self._collective_group
+        tx = self._tx
+
+        @jax.jit
+        def grads_fn(params, batch, rng):
+            def loss_fn(p):
+                return self.compute_loss(p, batch, rng)
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        @jax.jit
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        def stepped(params, opt_state, batch, rng):
+            from ...util import collective
+
+            grads, metrics = grads_fn(params, batch, rng)
+            flat, tree = jax.tree_util.tree_flatten(grads)
+            reduced = [
+                collective.allreduce(np.asarray(g), group_name=group, op="mean")
+                for g in flat
+            ]
+            grads = jax.tree_util.tree_unflatten(tree, reduced)
+            params, opt_state = apply_fn(params, opt_state, grads)
+            return params, opt_state, metrics
+
+        return stepped
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """Run minibatch SGD over the batch; returns averaged metrics."""
+        import jax
+        import jax.numpy as jnp
+
+        self._ensure_jit()
+        minibatch = self.config.get("minibatch_size")
+        epochs = self.config.get("num_epochs", 1)
+        n = len(
+            next(v for v in batch.values() if not isinstance(v, dict))
+        )
+        all_metrics: List[Dict[str, Any]] = []
+        for _ in range(epochs):
+            if minibatch and minibatch < n:
+                perm = self._np_rng.permutation(n)
+                # Truncate to full minibatches: static shapes keep XLA
+                # from recompiling per ragged tail.
+                num_mb = n // minibatch
+                idxs = [
+                    perm[i * minibatch : (i + 1) * minibatch]
+                    for i in range(num_mb)
+                ]
+            else:
+                idxs = [None]
+            for idx in idxs:
+                mb = (
+                    batch
+                    if idx is None
+                    else {
+                        k: (v[idx] if not isinstance(v, dict) else v)
+                        for k, v in batch.items()
+                    }
+                )
+                # dict-valued entries are param pytrees (e.g. a target
+                # network) riding along as jit args — pass through.
+                mb = {
+                    k: (jnp.asarray(v) if not isinstance(v, dict) else v)
+                    for k, v in mb.items()
+                }
+                self._rng, step_rng = jax.random.split(self._rng)
+                self.params, self.opt_state, metrics = self._jit_update(
+                    self.params, self.opt_state, mb, step_rng
+                )
+                all_metrics.append(metrics)
+        out = {
+            k: float(np.mean([jax.device_get(m[k]) for m in all_metrics]))
+            for k in all_metrics[0]
+        }
+        return out
+
+    # ----------------------------------------------------------- weights
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = weights
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+    # ------------------------------------------------- collective (DCN)
+    def setup_collective(self, group_name: str, world_size: int, rank: int):
+        from ...util import collective
+
+        collective.init_collective_group(
+            world_size=world_size, rank=rank, group_name=group_name
+        )
+        self._collective_group = group_name
+        self._jit_update = None
+
+
+class LearnerActor:
+    """Hosts a Learner in a worker process (possibly bound to TPU
+    chips); thin RPC surface for LearnerGroup."""
+
+    def __init__(self, learner_cls_blob: bytes, module_spec_blob: bytes,
+                 config_blob: bytes):
+        import pickle
+
+        learner_cls = pickle.loads(learner_cls_blob)
+        self._learner: Learner = learner_cls(
+            module_spec=pickle.loads(module_spec_blob),
+            config=pickle.loads(config_blob),
+        )
+        self._learner.build()
+
+    def setup_collective(self, group_name: str, world_size: int, rank: int):
+        self._learner.setup_collective(group_name, world_size, rank)
+        return rank
+
+    def update_from_episodes(self, episodes):
+        batch = self._learner.build_batch(episodes)  # type: ignore[attr-defined]
+        return self._learner.update(batch)
+
+    def update(self, batch):
+        return self._learner.update(batch)
+
+    def get_weights(self):
+        return self._learner.get_weights()
+
+    def set_weights(self, weights):
+        self._learner.set_weights(weights)
+
+    def get_state(self):
+        return self._learner.get_state()
+
+    def set_state(self, state):
+        self._learner.set_state(state)
+
+    def call(self, method: str, *args, **kwargs):
+        return getattr(self._learner, method)(*args, **kwargs)

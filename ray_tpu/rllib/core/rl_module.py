@@ -1,0 +1,175 @@
+"""RLModule: the neural-network abstraction of the new API stack.
+
+Reference: rllib/core/rl_module/rl_module.py — a framework-native module
+with three forward passes (inference / exploration / train). Here the
+module is *functional* (flax): parameters live outside the module and
+every forward is a pure ``apply(params, batch)`` so the learner can jit
+the whole update and env runners can run the same apply on CPU numpy
+weights. This is the TPU-first inversion of the reference's stateful
+torch modules.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Columns:
+    """Batch column names (reference: rllib/core/columns.py)."""
+
+    OBS = "obs"
+    ACTIONS = "actions"
+    REWARDS = "rewards"
+    NEXT_OBS = "next_obs"
+    TERMINATEDS = "terminateds"
+    TRUNCATEDS = "truncateds"
+    ACTION_LOGP = "action_logp"
+    ACTION_DIST_INPUTS = "action_dist_inputs"
+    VF_PREDS = "vf_preds"
+    ADVANTAGES = "advantages"
+    VALUE_TARGETS = "value_targets"
+    LOSS_MASK = "loss_mask"
+
+
+class RLModule:
+    """Subclass and implement ``setup`` + the forward methods.
+
+    All forwards are pure functions of (params, batch) returning a dict
+    of outputs; ``init_params(rng)`` builds fresh parameters.
+    """
+
+    def __init__(self, observation_space, action_space, model_config: dict):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.model_config = dict(model_config or {})
+        self.setup()
+
+    # ------------------------------------------------------------- hooks
+    def setup(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+    def init_params(self, rng) -> Any:
+        raise NotImplementedError
+
+    def forward_inference(self, params, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Greedy/deterministic forward for evaluation & serving."""
+        return self.forward_exploration(params, batch)
+
+    def forward_exploration(self, params, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Stochastic forward used for sample collection."""
+        raise NotImplementedError
+
+    def forward_train(self, params, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Forward used inside the loss (jitted by the learner)."""
+        raise NotImplementedError
+
+    # --------------------------------------------------------- utilities
+    def input_dim(self) -> int:
+        space = self.observation_space
+        return int(np.prod(space.shape))
+
+    def num_actions(self) -> int:
+        import gymnasium as gym
+
+        if isinstance(self.action_space, gym.spaces.Discrete):
+            return int(self.action_space.n)
+        return int(np.prod(self.action_space.shape))
+
+
+@dataclass
+class RLModuleSpec:
+    """Builds an RLModule from spaces + config (reference:
+    rllib/core/rl_module/rl_module.py RLModuleSpec)."""
+
+    module_class: Optional[type] = None
+    observation_space: Any = None
+    action_space: Any = None
+    model_config: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self) -> RLModule:
+        if self.module_class is None:
+            raise ValueError("RLModuleSpec.module_class not set")
+        return self.module_class(
+            self.observation_space, self.action_space, self.model_config
+        )
+
+
+# --------------------------------------------------------------- flax MLPs
+def _mlp(hidden: Sequence[int], out: int, out_scale: float = 0.01):
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = x.reshape((x.shape[0], -1))
+            for h in hidden:
+                x = nn.tanh(nn.Dense(h)(x))
+            # Small-scale head init: near-uniform initial policy and
+            # near-zero initial values — bootstrapped targets (V-trace,
+            # TD) start unbiased instead of propagating init noise.
+            return nn.Dense(
+                out,
+                kernel_init=nn.initializers.variance_scaling(
+                    out_scale, "fan_in", "truncated_normal"
+                ),
+            )(x)
+
+    return MLP()
+
+
+class DiscretePolicyModule(RLModule):
+    """Categorical policy + value head over an MLP trunk — the default
+    module for discrete-action envs (reference: rllib default
+    PPO/IMPALA catalog MLP models)."""
+
+    def setup(self) -> None:
+        hidden = tuple(self.model_config.get("fcnet_hiddens", (64, 64)))
+        self._pi = _mlp(hidden, self.num_actions())
+        self._vf = _mlp(hidden, 1)
+
+    def init_params(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        dummy = jnp.zeros((1, self.input_dim()), jnp.float32)
+        k1, k2 = jax.random.split(rng)
+        return {
+            "pi": self._pi.init(k1, dummy),
+            "vf": self._vf.init(k2, dummy),
+        }
+
+    def forward_exploration(self, params, batch):
+        logits = self._pi.apply(params["pi"], batch[Columns.OBS])
+        return {Columns.ACTION_DIST_INPUTS: logits}
+
+    def forward_train(self, params, batch):
+        obs = batch[Columns.OBS]
+        logits = self._pi.apply(params["pi"], obs)
+        vf = self._vf.apply(params["vf"], obs)[..., 0]
+        return {Columns.ACTION_DIST_INPUTS: logits, Columns.VF_PREDS: vf}
+
+    def compute_values(self, params, obs):
+        return self._vf.apply(params["vf"], obs)[..., 0]
+
+
+class QNetworkModule(RLModule):
+    """Q-network (+ target handled by the learner) for DQN."""
+
+    def setup(self) -> None:
+        hidden = tuple(self.model_config.get("fcnet_hiddens", (64, 64)))
+        self._q = _mlp(hidden, self.num_actions())
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        dummy = jnp.zeros((1, self.input_dim()), jnp.float32)
+        return {"q": self._q.init(rng, dummy)}
+
+    def forward_exploration(self, params, batch):
+        q = self._q.apply(params["q"], batch[Columns.OBS])
+        return {"q_values": q, Columns.ACTION_DIST_INPUTS: q}
+
+    def forward_train(self, params, batch):
+        return {"q_values": self._q.apply(params["q"], batch[Columns.OBS])}

@@ -42,8 +42,21 @@ class _DeploymentAutoscaling:
         vals = [v for (t, v) in series if t >= lo]
         return sum(vals) / len(vals) if vals else 0.0
 
+    def _prune(self, now: float) -> None:
+        """Drop series from replicas/handles gone longer than the
+        look-back window (otherwise controller memory and per-tick work
+        grow with replica churn forever)."""
+        horizon = now - 2 * self.config.look_back_period_s
+        for table in (self.replica_metrics, self.handle_metrics):
+            dead = [
+                k for k, s in table.items() if not s or s[-1][0] < horizon
+            ]
+            for k in dead:
+                del table[k]
+
     def decide(self, now: Optional[float] = None) -> int:
         now = time.time() if now is None else now
+        self._prune(now)
         cfg = self.config
         total = sum(
             self._windowed_mean(s, now) for s in self.replica_metrics.values()

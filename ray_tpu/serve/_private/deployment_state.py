@@ -73,8 +73,9 @@ class DeploymentState:
         self._target: Optional[DeploymentTarget] = None
         self._replicas: List[_Replica] = []
         self._status = DeploymentStatusInfo(DeploymentStatus.UPDATING)
-        self._last_broadcast: Optional[List[str]] = None
+        self._last_broadcast: Optional[list] = None
         self._message = ""
+        self._consecutive_start_failures = 0
 
     # ------------------------------------------------------------ target
     def set_target(self, target: DeploymentTarget):
@@ -129,11 +130,14 @@ class DeploymentState:
 
                     get(r.start_ref)
                     r.state = ReplicaState.RUNNING
+                    self._consecutive_start_failures = 0
                 except Exception as e:  # noqa: BLE001 - constructor failed
                     self._message = f"replica constructor failed: {e!r}"
+                    self._consecutive_start_failures += 1
                     self._stop_replica(r, graceful=False)
             elif time.monotonic() - r.started_at > self.START_TIMEOUT_S:
                 self._message = "replica start timed out"
+                self._consecutive_start_failures += 1
                 self._stop_replica(r, graceful=False)
 
     def _check_stopping_replicas(self):
@@ -158,23 +162,48 @@ class DeploymentState:
         self._replicas = still
 
     def _reconfigure_or_replace_outdated(self):
+        """Surge rollout: old-version replicas keep serving until the
+        new version has target_num_replicas RUNNING, then stop — a code
+        redeploy never hits a zero-replica window (jax models can take
+        seconds-to-minutes of compile in the new replicas)."""
         t = self._target
         cfg_hash = _user_config_hash(t.config)
-        for r in list(self._replicas):
-            if r.state == ReplicaState.STOPPING:
-                continue
-            if r.version != t.code_version:
-                # Code changed: replace (rolling — scale loop restarts it).
-                self._stop_replica(r, graceful=True)
-            elif r.user_config_hash != cfg_hash and r.state == ReplicaState.RUNNING:
+        old = [
+            r
+            for r in self._replicas
+            if r.state != ReplicaState.STOPPING and r.version != t.code_version
+        ]
+        if old:
+            new_running = [
+                r
+                for r in self._running()
+                if r.version == t.code_version
+            ]
+            if len(new_running) >= t.target_num_replicas:
+                for r in old:
+                    self._stop_replica(r, graceful=True)
+        for r in self._replicas:
+            if (
+                r.state == ReplicaState.RUNNING
+                and r.version == t.code_version
+                and r.user_config_hash != cfg_hash
+            ):
                 r.handle.reconfigure.remote(t.config.user_config)
                 r.user_config_hash = cfg_hash
 
     def _scale_to_target(self):
         t = self._target
-        alive = [r for r in self._replicas if r.state != ReplicaState.STOPPING]
+        # Only new-version replicas count toward the target; old ones
+        # are surge capacity handled above.
+        alive = [
+            r
+            for r in self._replicas
+            if r.state != ReplicaState.STOPPING and r.version == t.code_version
+        ]
         delta = t.target_num_replicas - len(alive)
         if delta > 0:
+            if self._consecutive_start_failures >= self.MAX_START_FAILURES:
+                return  # crash loop — stop burning workers
             for _ in range(delta):
                 self._start_replica()
         elif delta < 0:
@@ -295,9 +324,18 @@ class DeploymentState:
             {LongPollKey.running_replicas(self._id): infos}
         )
 
+    MAX_START_FAILURES = 3
+
     def _refresh_status(self):
         n_running = len(self._running())
         target = self.target_num_replicas
+        if self._consecutive_start_failures >= self.MAX_START_FAILURES:
+            # Crash loop: stop retrying and surface DEPLOY_FAILED.
+            self._status = DeploymentStatusInfo(
+                DeploymentStatus.UNHEALTHY, self._message,
+                num_replicas=n_running,
+            )
+            return
         if n_running == target and all(
             r.state == ReplicaState.RUNNING
             for r in self._replicas

@@ -1,0 +1,32 @@
+"""ray_tpu.workflow: durable, resumable task DAGs.
+
+Reference: python/ray/workflow/ (api.py:123 run, workflow_executor.py,
+workflow_storage.py). A workflow executes a DAG of tasks with
+every task's output persisted; re-running (``resume``) skips completed
+tasks, giving exactly-once semantics across driver crashes.
+"""
+from __future__ import annotations
+
+from .api import (  # noqa: F401
+    cancel,
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "cancel",
+    "delete",
+    "get_output",
+    "get_status",
+    "init",
+    "list_all",
+    "resume",
+    "run",
+    "run_async",
+]

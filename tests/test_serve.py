@@ -235,6 +235,24 @@ def test_multiplexed_models(serve_session):
     serve.delete("mux")
 
 
+def test_failing_deployment_reports_deploy_failed(serve_session):
+    """A crash-looping constructor surfaces DEPLOY_FAILED instead of
+    hanging serve.run until timeout."""
+
+    @serve.deployment
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("boom at init")
+
+        def __call__(self, x):
+            return x
+
+    with pytest.raises(RuntimeError, match="Deploy failed"):
+        serve.run(Broken.bind(), name="broken", route_prefix=None,
+                  timeout_s=60)
+    serve.delete("broken")
+
+
 def test_replica_recovery_after_kill(serve_session):
     @serve.deployment(health_check_period_s=0.2)
     class Sturdy:
