@@ -1,0 +1,450 @@
+// Shared-memory object-store core (plasma equivalent, C++).
+//
+// The reference's plasma store (src/ray/object_manager/plasma/store.h,
+// plasma_allocator.h + vendored dlmalloc) manages mmap arenas with a
+// malloc-style allocator, an object table with per-object refcounts and
+// states (created → sealed), and LRU eviction of sealed, unreferenced
+// objects. This is the same design collapsed into one shm pool shared
+// by every process on the node:
+//
+//   [Header | object table (open addressing) | arena]
+//
+// All cross-process state lives in the pool; a robust process-shared
+// pthread mutex guards the table + allocator, so a crashed worker can
+// never wedge the store. Data payloads are written/read directly by
+// Python through a zero-copy memoryview of the same mapping — this
+// library owns METADATA AND ALLOCATION only, which is where the Python
+// implementation (one shm segment + 3 syscalls per object) loses.
+//
+// Allocator: segregated-free-list-free classic boundary-tag malloc
+// (header+footer per block, explicit doubly-linked free list,
+// first-fit with splitting and bidirectional coalescing), 64-byte
+// alignment so payloads are cache-line- and dlpack-friendly.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055504F4F4CULL;  // "RTPUPOOL"
+constexpr uint64_t kNull = ~0ULL;
+constexpr uint64_t kAlign = 64;
+constexpr uint64_t kBlockHeader = 16;  // [size u64][flags u64]
+constexpr uint64_t kBlockFooter = 8;   // [size u64]
+constexpr uint64_t kMinBlock = 128;
+constexpr uint32_t kStateEmpty = 0;
+constexpr uint32_t kStateCreated = 1;
+constexpr uint32_t kStateSealed = 2;
+constexpr uint32_t kStateTombstone = 3;
+
+struct Entry {
+  uint8_t id[16];
+  uint64_t offset;  // arena-relative payload offset
+  uint64_t size;
+  uint32_t state;
+  int32_t refcount;
+  uint64_t lru;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t pool_size;
+  uint32_t evict_enabled;  // 0: full pool fails create (caller falls back)
+  uint32_t _pad0;
+  uint64_t table_offset;
+  uint64_t arena_offset;
+  uint64_t arena_size;
+  uint32_t max_objects;
+  uint32_t _pad;
+  pthread_mutex_t mutex;
+  uint64_t lru_clock;
+  uint64_t free_head;  // arena-relative offset of first free block
+  // stats
+  uint64_t bytes_in_use;
+  uint64_t num_objects;
+  uint64_t num_evictions;
+  uint64_t bytes_evicted;
+};
+
+struct Store {
+  uint8_t* base;
+  Header* h;
+  uint64_t map_size;
+  char name[256];
+};
+
+inline Entry* table(Store* s) {
+  return reinterpret_cast<Entry*>(s->base + s->h->table_offset);
+}
+inline uint8_t* arena(Store* s) { return s->base + s->h->arena_offset; }
+
+// ---------------------------------------------------------------- blocks
+// Block layout: [size u64][flags u64][payload ...][size u64]
+// flags bit0 = allocated. Free blocks keep next/prev (arena offsets) in
+// the first 16 payload bytes.
+inline uint64_t blk_size(Store* s, uint64_t off) {
+  return *reinterpret_cast<uint64_t*>(arena(s) + off);
+}
+inline uint64_t blk_flags(Store* s, uint64_t off) {
+  return *reinterpret_cast<uint64_t*>(arena(s) + off + 8);
+}
+inline void blk_set(Store* s, uint64_t off, uint64_t size, uint64_t flags) {
+  *reinterpret_cast<uint64_t*>(arena(s) + off) = size;
+  *reinterpret_cast<uint64_t*>(arena(s) + off + 8) = flags;
+  *reinterpret_cast<uint64_t*>(arena(s) + off + size - kBlockFooter) = size;
+}
+inline uint64_t& blk_next(Store* s, uint64_t off) {
+  return *reinterpret_cast<uint64_t*>(arena(s) + off + kBlockHeader);
+}
+inline uint64_t& blk_prev(Store* s, uint64_t off) {
+  return *reinterpret_cast<uint64_t*>(arena(s) + off + kBlockHeader + 8);
+}
+
+void freelist_insert(Store* s, uint64_t off) {
+  blk_next(s, off) = s->h->free_head;
+  blk_prev(s, off) = kNull;
+  if (s->h->free_head != kNull) blk_prev(s, s->h->free_head) = off;
+  s->h->free_head = off;
+}
+
+void freelist_remove(Store* s, uint64_t off) {
+  uint64_t n = blk_next(s, off), p = blk_prev(s, off);
+  if (p != kNull) blk_next(s, p) = n; else s->h->free_head = n;
+  if (n != kNull) blk_prev(s, n) = p;
+}
+
+uint64_t round_up(uint64_t v, uint64_t a) { return (v + a - 1) / a * a; }
+
+// Returns arena-relative PAYLOAD offset or kNull.
+uint64_t arena_alloc(Store* s, uint64_t payload) {
+  uint64_t need = round_up(payload + kBlockHeader + kBlockFooter, kAlign);
+  if (need < kMinBlock) need = kMinBlock;
+  for (uint64_t off = s->h->free_head; off != kNull; off = blk_next(s, off)) {
+    uint64_t sz = blk_size(s, off);
+    if (sz < need) continue;
+    freelist_remove(s, off);
+    if (sz - need >= kMinBlock) {  // split
+      blk_set(s, off + need, sz - need, 0);
+      freelist_insert(s, off + need);
+      blk_set(s, off, need, 1);
+    } else {
+      blk_set(s, off, sz, 1);
+    }
+    s->h->bytes_in_use += blk_size(s, off);
+    return off + kBlockHeader;
+  }
+  return kNull;
+}
+
+void arena_free(Store* s, uint64_t payload_off) {
+  uint64_t off = payload_off - kBlockHeader;
+  uint64_t sz = blk_size(s, off);
+  s->h->bytes_in_use -= sz;
+  // Coalesce with next block.
+  uint64_t next = off + sz;
+  if (next < s->h->arena_size && (blk_flags(s, next) & 1) == 0) {
+    freelist_remove(s, next);
+    sz += blk_size(s, next);
+  }
+  // Coalesce with previous block (via its footer).
+  if (off > 0) {
+    uint64_t prev_sz = *reinterpret_cast<uint64_t*>(arena(s) + off - kBlockFooter);
+    uint64_t prev = off - prev_sz;
+    if ((blk_flags(s, prev) & 1) == 0) {
+      freelist_remove(s, prev);
+      off = prev;
+      sz += prev_sz;
+    }
+  }
+  blk_set(s, off, sz, 0);
+  freelist_insert(s, off);
+}
+
+// ----------------------------------------------------------------- table
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t h;
+  std::memcpy(&h, id, 8);
+  uint64_t l;
+  std::memcpy(&l, id + 8, 8);
+  h ^= l * 0x9E3779B97F4A7C15ULL;
+  h ^= h >> 31;
+  return h;
+}
+
+Entry* find_entry(Store* s, const uint8_t* id, bool for_insert) {
+  uint32_t cap = s->h->max_objects;
+  uint64_t idx = hash_id(id) % cap;
+  Entry* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < cap; ++probe) {
+    Entry* e = &table(s)[(idx + probe) % cap];
+    if (e->state == kStateEmpty) {
+      return for_insert ? (first_tomb ? first_tomb : e) : nullptr;
+    }
+    if (e->state == kStateTombstone) {
+      if (for_insert && !first_tomb) first_tomb = e;
+      continue;
+    }
+    if (std::memcmp(e->id, id, 16) == 0) return e;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+void lock(Store* s) {
+  int rc = pthread_mutex_lock(&s->h->mutex);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&s->h->mutex);
+}
+void unlock(Store* s) { pthread_mutex_unlock(&s->h->mutex); }
+
+void free_entry(Store* s, Entry* e) {
+  arena_free(s, e->offset);
+  e->state = kStateTombstone;
+  e->offset = kNull;
+  s->h->num_objects--;
+}
+
+// Evict sealed refcount-0 objects (LRU first) until at least `need`
+// payload bytes can be allocated. Returns payload offset or kNull.
+uint64_t alloc_with_eviction(Store* s, uint64_t need) {
+  uint64_t off = arena_alloc(s, need);
+  while (off == kNull && s->h->evict_enabled) {
+    Entry* victim = nullptr;
+    uint32_t cap = s->h->max_objects;
+    for (uint32_t i = 0; i < cap; ++i) {
+      Entry* e = &table(s)[i];
+      if (e->state == kStateSealed && e->refcount == 0) {
+        if (!victim || e->lru < victim->lru) victim = e;
+      }
+    }
+    if (!victim) return kNull;
+    s->h->num_evictions++;
+    s->h->bytes_evicted += victim->size;
+    free_entry(s, victim);
+    off = arena_alloc(s, need);
+  }
+  return off;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new pool. Returns handle (opaque ptr) or 0 on failure.
+// evict_enabled=0 is the safe default for a session pool: nothing pins
+// client-referenced objects across processes yet, so eviction could free
+// data a live ObjectRef still names. With eviction off a full pool fails
+// the create and the caller falls back to per-object segments.
+uint64_t store_create(const char* name, uint64_t pool_bytes,
+                      uint32_t max_objects, int32_t evict_enabled) {
+  uint64_t table_bytes = round_up((uint64_t)max_objects * sizeof(Entry), kAlign);
+  uint64_t header_bytes = round_up(sizeof(Header), kAlign);
+  uint64_t total = round_up(header_bytes + table_bytes + pool_bytes, 4096);
+
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return 0;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return 0;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) {
+    shm_unlink(name);
+    return 0;
+  }
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(base);
+  s->map_size = total;
+  std::snprintf(s->name, sizeof(s->name), "%s", name);
+  Header* h = s->h = reinterpret_cast<Header*>(base);
+  h->pool_size = total;
+  h->table_offset = header_bytes;
+  h->arena_offset = header_bytes + table_bytes;
+  h->arena_size = total - h->arena_offset;
+  h->max_objects = max_objects;
+  h->lru_clock = 1;
+  h->evict_enabled = (uint32_t)evict_enabled;
+  h->free_head = kNull;
+  h->bytes_in_use = 0;
+  h->num_objects = 0;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  std::memset(s->base + h->table_offset, 0, table_bytes);
+  // One big free block spanning the arena.
+  blk_set(s, 0, h->arena_size, 0);
+  freelist_insert(s, 0);
+  h->magic = kMagic;  // last: attachers spin on magic
+  return reinterpret_cast<uint64_t>(s);
+}
+
+uint64_t store_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return 0;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return 0;
+  }
+  void* base =
+      mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return 0;
+  Header* h = reinterpret_cast<Header*>(base);
+  if (h->magic != kMagic) {
+    munmap(base, (size_t)st.st_size);
+    return 0;
+  }
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(base);
+  s->h = h;
+  s->map_size = (size_t)st.st_size;
+  std::snprintf(s->name, sizeof(s->name), "%s", name);
+  return reinterpret_cast<uint64_t>(s);
+}
+
+// Returns ABSOLUTE payload offset within the mapping (for Python's
+// memoryview slicing), or 0 on failure (0 is inside the header, never a
+// valid payload). err: 1 = exists, 2 = full, 3 = table full.
+uint64_t store_create_object(uint64_t handle, const uint8_t* id, uint64_t size,
+                             int32_t* err) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* existing = find_entry(s, id, false);
+  if (existing) {
+    unlock(s);
+    if (err) *err = 1;
+    return 0;
+  }
+  Entry* e = find_entry(s, id, true);
+  if (!e) {
+    unlock(s);
+    if (err) *err = 3;
+    return 0;
+  }
+  uint64_t off = alloc_with_eviction(s, size ? size : 1);
+  if (off == kNull) {
+    unlock(s);
+    if (err) *err = 2;
+    return 0;
+  }
+  std::memcpy(e->id, id, 16);
+  e->offset = off;
+  e->size = size;
+  e->state = kStateCreated;
+  e->refcount = 1;  // creator holds a ref until seal+release
+  e->lru = s->h->lru_clock++;
+  s->h->num_objects++;
+  unlock(s);
+  if (err) *err = 0;
+  return s->h->arena_offset + off;
+}
+
+int32_t store_seal(uint64_t handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kStateCreated) {
+    unlock(s);
+    return -1;
+  }
+  e->state = kStateSealed;
+  e->refcount -= 1;
+  unlock(s);
+  return 0;
+}
+
+// Get a sealed object: bumps refcount. Returns 0 on success.
+int32_t store_get(uint64_t handle, const uint8_t* id, uint64_t* abs_offset,
+                  uint64_t* size) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state != kStateSealed) {
+    unlock(s);
+    return -1;
+  }
+  e->refcount++;
+  e->lru = s->h->lru_clock++;
+  *abs_offset = s->h->arena_offset + e->offset;
+  *size = e->size;
+  unlock(s);
+  return 0;
+}
+
+int32_t store_contains(uint64_t handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id, false);
+  int32_t ok = (e && e->state == kStateSealed) ? 1 : 0;
+  unlock(s);
+  return ok;
+}
+
+int32_t store_release(uint64_t handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id, false);
+  if (!e || e->state == kStateEmpty || e->state == kStateTombstone) {
+    unlock(s);
+    return -1;
+  }
+  if (e->refcount > 0) e->refcount--;
+  unlock(s);
+  return 0;
+}
+
+int32_t store_delete(uint64_t handle, const uint8_t* id) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  Entry* e = find_entry(s, id, false);
+  if (!e) {
+    unlock(s);
+    return -1;
+  }
+  if (e->refcount > 0) {
+    // Deferred: evictable the moment the refcount drops (mark LRU-old).
+    e->lru = 0;
+    unlock(s);
+    return 1;
+  }
+  free_entry(s, e);
+  unlock(s);
+  return 0;
+}
+
+void store_stats(uint64_t handle, uint64_t* out8) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  out8[0] = s->h->arena_size;
+  out8[1] = s->h->bytes_in_use;
+  out8[2] = s->h->num_objects;
+  out8[3] = s->h->num_evictions;
+  out8[4] = s->h->bytes_evicted;
+  out8[5] = s->h->pool_size;
+  out8[6] = s->h->max_objects;
+  out8[7] = 0;
+  unlock(s);
+}
+
+void store_detach(uint64_t handle) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  munmap(s->base, s->map_size);
+  delete s;
+}
+
+int32_t store_destroy(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

@@ -6,7 +6,8 @@ python/ray/experimental/channel/shared_memory_channel.py): a
 single-slot shared buffer a writer and reader rendezvous on, avoiding
 per-message RPC entirely.
 
-Layout: [8B write_seq][8B read_seq][8B payload_len][payload...].
+Layout: [8B write_seq][8B read_seq][8B payload_len][8B closed]
+[payload...].
 Single-producer single-consumer; a pair of POSIX named semaphores
 ("items" posted by the writer, "space" posted by the reader) gives
 true blocking rendezvous — no polling, microsecond wakeups.
@@ -20,7 +21,7 @@ from typing import Any, Optional
 
 from .posix_sem import NamedSemaphore
 
-_HEADER = 24
+_HEADER = 32
 _CLOSED_LEN = 0xFFFFFFFFFFFFFFFF
 
 
@@ -35,7 +36,7 @@ class Channel:
                 create=True, size=_HEADER + capacity
             )
             self._owner = True
-            struct.pack_into("<QQQ", self._shm.buf, 0, 0, 0, 0)
+            struct.pack_into("<QQQQ", self._shm.buf, 0, 0, 0, 0, 0)
         else:
             self._shm = shared_memory.SharedMemory(name=name)
             self._owner = False
@@ -66,6 +67,10 @@ class Channel:
         w, r = struct.unpack_from("<QQ", self._shm.buf, 0)
         return w, r
 
+    def _closed(self) -> int:
+        (c,) = struct.unpack_from("<Q", self._shm.buf, 24)
+        return c
+
     # ----------------------------------------------------------- write
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
         payload = pickle.dumps(value, protocol=5)
@@ -74,11 +79,13 @@ class Channel:
                 f"payload {len(payload)}B exceeds channel capacity "
                 f"{self.capacity}B"
             )
+        if self._closed():
+            raise ChannelClosed
         if not self._space.wait(timeout):
             raise TimeoutError("channel write timed out")
-        w, r = self._seqs()
-        if r == _CLOSED_LEN or w == _CLOSED_LEN:
+        if self._closed():
             raise ChannelClosed
+        w, r = self._seqs()
         struct.pack_into("<Q", self._shm.buf, 16, len(payload))
         self._shm.buf[_HEADER : _HEADER + len(payload)] = payload
         struct.pack_into("<Q", self._shm.buf, 0, w + 1)
@@ -89,7 +96,10 @@ class Channel:
         if not self._items.wait(timeout):
             raise TimeoutError("channel read timed out")
         w, r = self._seqs()
-        if w == _CLOSED_LEN:
+        if w == r:
+            # Woken by close, not by data: EOF after draining everything
+            # (an in-flight payload written before close is still
+            # delivered — close never discards messages).
             raise ChannelClosed
         (n,) = struct.unpack_from("<Q", self._shm.buf, 16)
         value = pickle.loads(bytes(self._shm.buf[_HEADER : _HEADER + n]))
@@ -99,12 +109,13 @@ class Channel:
 
     # ----------------------------------------------------------- close
     def close_writer(self) -> None:
-        """Signal EOF to the reader (wakes a blocked read)."""
-        struct.pack_into("<Q", self._shm.buf, 0, _CLOSED_LEN)
+        """Signal EOF to the reader (wakes a blocked read). Messages
+        already written remain readable before EOF is raised."""
+        struct.pack_into("<Q", self._shm.buf, 24, 1)
         self._items.post()
 
     def close_reader(self) -> None:
-        struct.pack_into("<Q", self._shm.buf, 8, _CLOSED_LEN)
+        struct.pack_into("<Q", self._shm.buf, 24, 1)
         self._space.post()
 
     def destroy(self) -> None:

@@ -202,13 +202,4 @@ class CoreClient:
 
 
 def object_segment_put(store: ObjectStore, oid: ObjectID, payload, buffers, size) -> str:
-    from multiprocessing import shared_memory
-
-    from .object_store import segment_name, _untrack
-
-    name = segment_name(oid)
-    shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
-    _untrack(shm)
-    serialization.write_to(shm.buf, payload, buffers)
-    store._segments[name] = shm  # noqa: SLF001 — retain mapping
-    return name
+    return store.put_serialized(oid, payload, buffers, size)

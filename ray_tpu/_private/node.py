@@ -66,6 +66,18 @@ class Node:
         # AF_UNIX socket paths are length-limited (~107 bytes); keep it short.
         self.address = os.path.join(self.session_dir, "gcs.sock")
         self.authkey = secrets.token_bytes(16)
+        # Node-wide C++ object-store pool (plasma equivalent); workers
+        # inherit the name via the environment and attach.
+        self._pool = None
+        try:
+            from .native_store import PoolStore, native_available
+
+            if native_available():
+                pool_name = f"/rtpu_pool_{secrets.token_hex(4)}"
+                self._pool = PoolStore(pool_name, create=True)
+                os.environ["RAY_TPU_POOL_NAME"] = pool_name
+        except Exception:  # noqa: BLE001 - per-object segments fallback
+            self._pool = None
         self.gcs = GcsServer(
             session_dir=self.session_dir,
             address=self.address,
@@ -75,5 +87,12 @@ class Node:
 
     def shutdown(self, cleanup_session: bool = True):
         self.gcs.shutdown()
+        if self._pool is not None:
+            try:
+                self._pool.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+            self._pool = None
+            os.environ.pop("RAY_TPU_POOL_NAME", None)
         if cleanup_session:
             shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -14,6 +14,7 @@ Objects are immutable once sealed, matching plasma semantics.
 """
 from __future__ import annotations
 
+import os
 import threading
 from multiprocessing import shared_memory, resource_tracker
 from typing import Any, Dict, Optional, Tuple
@@ -37,32 +38,65 @@ def segment_name(object_id: ObjectID) -> str:
 
 
 class ObjectStore:
-    """Node-local store of sealed shm objects; one instance per process.
+    """Node-local store of sealed objects; one instance per process.
 
-    Keeps mappings of segments this process has created or read. Values
-    returned by ``get`` hold zero-copy views into the mapping; the mapping
-    is retained in ``_segments`` until ``release``d.
+    Fast path: the C++ pool store (native/store.cpp — one shm pool,
+    boundary-tag allocator, shared refcounts, LRU eviction) attached by
+    every process on the node via $RAY_TPU_POOL_NAME. Fallback (no
+    toolchain / pool full / oversized object): one shm segment per
+    object, as before. Values returned by ``get`` hold zero-copy views
+    into the mapping; mappings/refcounts are retained until ``release``.
     """
 
     def __init__(self):
         self._segments: Dict[str, shared_memory.SharedMemory] = {}
         self._lock = threading.Lock()
+        self._pool = None
+        self._pool_refs: Dict[bytes, int] = {}  # oid -> get() refcount held
+        pool_name = os.environ.get("RAY_TPU_POOL_NAME")
+        if pool_name:
+            try:
+                from .native_store import PoolStore, native_available
+
+                if native_available():
+                    self._pool = PoolStore(pool_name, create=False)
+            except Exception:  # noqa: BLE001 - fall back to segments
+                self._pool = None
 
     def put(self, object_id: ObjectID, value: Any) -> Tuple[str, int]:
-        """Serialize and seal a value; returns (segment_name, size)."""
+        """Serialize and seal a value; returns (location, size)."""
         value = serialization.prepare_value(value)
         payload, buffers = serialization.dumps(value)
         size = serialization.serialized_size(payload, buffers)
+        return self.put_serialized(object_id, payload, buffers, size), size
+
+    def put_serialized(self, object_id: ObjectID, payload, buffers, size) -> str:
+        """Write an already-serialized value; returns its location name."""
+        if self._pool is not None:
+            view = self._pool.create(object_id.binary(), max(size, 1))
+            if view is not None:
+                serialization.write_to(view, payload, buffers)
+                del view
+                self._pool.seal(object_id.binary())
+                return "pool"
         name = segment_name(object_id)
         shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
         _untrack(shm)
         serialization.write_to(shm.buf, payload, buffers)
         with self._lock:
             self._segments[name] = shm
-        return name, size
+        return name
 
     def get(self, object_id: ObjectID) -> Any:
         """Map and deserialize a sealed object (zero-copy buffers)."""
+        if self._pool is not None:
+            view = self._pool.get(object_id.binary())
+            if view is not None:
+                with self._lock:
+                    self._pool_refs[object_id.binary()] = (
+                        self._pool_refs.get(object_id.binary(), 0) + 1
+                    )
+                return serialization.unpack(view)
         name = segment_name(object_id)
         with self._lock:
             shm = self._segments.get(name)
@@ -73,6 +107,8 @@ class ObjectStore:
         return serialization.unpack(shm.buf)
 
     def contains(self, object_id: ObjectID) -> bool:
+        if self._pool is not None and self._pool.contains(object_id.binary()):
+            return True
         name = segment_name(object_id)
         with self._lock:
             if name in self._segments:
@@ -87,7 +123,14 @@ class ObjectStore:
             return False
 
     def release(self, object_id: ObjectID) -> None:
-        """Drop this process's mapping (does not delete the segment)."""
+        """Drop this process's mapping/refcount (does not delete)."""
+        if self._pool is not None:
+            with self._lock:
+                n = self._pool_refs.pop(object_id.binary(), 0)
+            for _ in range(n):
+                self._pool.release(object_id.binary())
+            if n:
+                return
         with self._lock:
             shm = self._segments.pop(segment_name(object_id), None)
         if shm is not None:
@@ -100,7 +143,13 @@ class ObjectStore:
                     self._segments[segment_name(object_id)] = shm
 
     def delete(self, object_id: ObjectID) -> None:
-        """Unlink the segment from the node (owner/GCS-driven)."""
+        """Unlink the object from the node (owner/GCS-driven)."""
+        if self._pool is not None:
+            with self._lock:
+                n = self._pool_refs.pop(object_id.binary(), 0)
+            for _ in range(n):
+                self._pool.release(object_id.binary())
+            self._pool.delete(object_id.binary())
         name = segment_name(object_id)
         with self._lock:
             shm = self._segments.pop(name, None)
@@ -125,6 +174,23 @@ class ObjectStore:
             pass
 
     def close(self) -> None:
+        if self._pool is not None:
+            # Drain held refcounts or the shared pool pins these objects
+            # forever (refcounts live in shm, not this process).
+            with self._lock:
+                refs = dict(self._pool_refs)
+                self._pool_refs.clear()
+            for oid, n in refs.items():
+                for _ in range(n):
+                    try:
+                        self._pool.release(oid)
+                    except Exception:  # noqa: BLE001
+                        break
+            try:
+                self._pool.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._pool = None
         with self._lock:
             segs = list(self._segments.values())
             self._segments.clear()

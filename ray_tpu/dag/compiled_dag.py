@@ -174,10 +174,20 @@ class CompiledDAG:
     def execute(self, *input_args) -> Any:
         if self._destroyed:
             raise RuntimeError("CompiledDAG already torn down")
+        if getattr(self, "_poisoned", False):
+            raise RuntimeError(
+                "CompiledDAG is poisoned: a previous execute() timed out "
+                "with a result still in flight (a later read would return "
+                "the stale result). teardown() and re-compile."
+            )
         value = input_args[0] if len(input_args) == 1 else input_args
-        for ch in self._input_channels:
-            ch.write(("ok", value), timeout=self._timeout)
-        status, result = self._output_channel.read(timeout=self._timeout)
+        try:
+            for ch in self._input_channels:
+                ch.write(("ok", value), timeout=self._timeout)
+            status, result = self._output_channel.read(timeout=self._timeout)
+        except TimeoutError:
+            self._poisoned = True
+            raise
         if status == "err":
             raise result
         return result

@@ -254,7 +254,14 @@ class IMPALA(Algorithm):
             idx = self._inflight.pop(ref)
             try:
                 episodes = ray_tpu.get(ref)
-            except Exception:  # runner died; manager will heal on next call
+            except Exception:
+                # Runner died: drop its OTHER in-flight refs too, or a
+                # stale ref failing later would restart (kill) the
+                # healthy replacement actor.
+                for stale in [
+                    r for r, i in self._inflight.items() if i == idx
+                ]:
+                    del self._inflight[stale]
                 mgr._restart(idx)
                 continue
             self._record_episodes(episodes)

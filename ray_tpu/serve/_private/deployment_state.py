@@ -81,6 +81,8 @@ class DeploymentState:
     def set_target(self, target: DeploymentTarget):
         self._target = target
         self._status = DeploymentStatusInfo(DeploymentStatus.UPDATING)
+        # A redeploy gets a fresh chance: clear the crash-loop latch.
+        self._consecutive_start_failures = 0
 
     def set_target_num_replicas(self, n: int):
         if self._target and not self._target.deleting:

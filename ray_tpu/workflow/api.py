@@ -53,6 +53,13 @@ def _task_ids(dag: DAGNode) -> Dict[int, str]:
 
 def _execute_durable(dag: DAGNode, workflow_id: str, storage: WorkflowStorage):
     ids = _task_ids(dag)
+    if any(
+        isinstance(n, InputNode) for n in dag.topological_order()
+    ):
+        raise ValueError(
+            "workflow DAGs must be fully bound (no InputNode): "
+            "workflow.run takes no runtime input"
+        )
     cache: Dict[int, Any] = {}
     pending: List = []  # (task_id, node_key, ref) in topo order
     storage.save_status(workflow_id, "RUNNING")
@@ -62,9 +69,6 @@ def _execute_durable(dag: DAGNode, workflow_id: str, storage: WorkflowStorage):
         # their stored values.
         for node in dag.topological_order():
             tid = ids[id(node)]
-            if isinstance(node, InputNode):
-                cache[id(node)] = None
-                continue
             if storage.has_task_result(workflow_id, tid):
                 cache[id(node)] = storage.load_task_result(workflow_id, tid)
                 continue
