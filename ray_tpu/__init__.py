@@ -36,6 +36,7 @@ from ._private.worker import (  # noqa: F401
     shutdown,
     wait,
 )
+from ._private.state import timeline  # noqa: F401
 from .actor import ActorClass, ActorHandle  # noqa: F401
 from .object_ref import ObjectRef  # noqa: F401
 from .remote_function import RemoteFunction  # noqa: F401

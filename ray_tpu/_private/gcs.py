@@ -164,6 +164,11 @@ class GcsServer:
         self.nodes: Dict[bytes, NodeState] = {}
         self.placement_groups: Dict[bytes, PlacementGroupState] = {}
         self._pending: deque[TaskSpec] = deque()
+        # Per-task state transitions for the state API, `ray_tpu
+        # timeline` (chrome://tracing) and the dashboard equivalent
+        # (reference: GcsTaskManager task-event store,
+        # gcs_task_manager.h:85). Bounded: oldest events roll off.
+        self.task_events: deque = deque(maxlen=100_000)
         self._store = ObjectStore()
         self._peers: List[PeerConn] = []
         self._shutdown = False
@@ -297,9 +302,18 @@ class GcsServer:
             blob = self.functions.get(msg["function_id"])
         state["peer"].reply(msg, ok=blob is not None, blob=blob)
 
+    def _record_task_event(self, task_id: bytes, name: str, event: str,
+                           worker_id: bytes = b""):
+        self.task_events.append(
+            (task_id, name, event, time.time(), worker_id)
+        )
+
     def _h_submit_task(self, state, msg):
         spec: TaskSpec = msg["spec"]
         with self._lock:
+            self._record_task_event(
+                spec.task_id.binary(), spec.name, "PENDING"
+            )
             if spec.function_blob is not None:
                 self.functions.setdefault(spec.function_id, spec.function_blob)
                 spec.function_blob = None
@@ -353,6 +367,10 @@ class GcsServer:
         w.inflight[spec.task_id.binary()] = spec
         try:
             w.conn.send({"type": "execute_task", "spec": spec})
+            self._record_task_event(
+                spec.task_id.binary(), spec.name, "RUNNING",
+                actor.worker_id.binary(),
+            )
         except ConnectionLost:
             w.inflight.pop(spec.task_id.binary(), None)
             actor.pending.append(spec)
@@ -365,6 +383,12 @@ class GcsServer:
             w = self.workers.get(wid)
             task_id = msg["task_id"]
             spec: Optional[TaskSpec] = w.inflight.get(task_id) if w else None
+            self._record_task_event(
+                task_id,
+                spec.name if spec else "?",
+                "FAILED" if error_blob is not None else "FINISHED",
+                wid,
+            )
             if w is not None:
                 w.inflight.pop(task_id, None)
                 if w.state == W_BUSY:
@@ -762,6 +786,138 @@ class GcsServer:
                 ],
             )
 
+    # ------------------------------------------------------------ state API
+
+    def _h_list_state(self, state, msg):
+        """Typed state listing for ray_tpu.util.state (reference:
+        util/state/api.py backed by the GCS + state aggregator)."""
+        kind = msg["kind"]
+        limit = msg.get("limit", 1000)
+        filters = msg.get("filters") or []
+        with self._lock:
+            if kind == "actors":
+                items = [
+                    {
+                        "actor_id": a.actor_id.hex(),
+                        "name": a.name or "",
+                        "state": a.state,
+                        "class_name": (
+                            a.spec.name.split(".")[0] if a.spec else ""
+                        ),
+                        "worker_id": a.worker_id.hex() if a.worker_id else "",
+                        "death_reason": a.death_reason or "",
+                    }
+                    for a in self.actors.values()
+                ]
+            elif kind == "nodes":
+                items = [
+                    {
+                        "node_id": n.node_id.hex(),
+                        "alive": n.alive,
+                        "label": n.label,
+                        "total": dict(n.total),
+                        "available": dict(n.available),
+                    }
+                    for n in self.nodes.values()
+                ]
+            elif kind == "workers":
+                items = [
+                    {
+                        "worker_id": w.worker_id.hex(),
+                        "state": w.state,
+                        "pid": w.proc.pid if w.proc else None,
+                        "node_id": w.node_id.hex(),
+                        "is_actor": w.actor_id is not None,
+                        "num_inflight": len(w.inflight),
+                    }
+                    for w in self.workers.values()
+                ]
+            elif kind == "objects":
+                items = [
+                    {
+                        "object_id": oid.hex(),
+                        "status": e.status,
+                        "size": e.size,
+                        "inline": e.inline is not None,
+                    }
+                    for oid, e in self.objects.items()
+                ]
+            elif kind == "placement_groups":
+                items = [
+                    {
+                        "placement_group_id": pg.pg_id.hex(),
+                        "state": pg.state,
+                        "bundles": [dict(b.resources) for b in pg.bundles],
+                        "strategy": pg.strategy,
+                    }
+                    for pg in self.placement_groups.values()
+                ]
+            elif kind == "tasks":
+                # Latest event per task id wins (state transitions are
+                # appended in order).
+                latest: Dict[bytes, Dict[str, Any]] = {}
+                for tid, name, event, ts, wid in self.task_events:
+                    latest[tid] = {
+                        "task_id": tid.hex(),
+                        "name": name,
+                        "state": event,
+                        "timestamp": ts,
+                        "worker_id": wid.hex() if wid else "",
+                    }
+                items = list(latest.values())
+            else:
+                state["peer"].reply(msg, ok=False, error=f"unknown kind {kind}")
+                return
+            # Filter BEFORE truncating, or matches past `limit` vanish.
+            for key, op, value in filters:
+                if op == "=":
+                    items = [i for i in items if i.get(key) == value]
+                elif op == "!=":
+                    items = [i for i in items if i.get(key) != value]
+        state["peer"].reply(msg, ok=True, items=items[:limit],
+                            total=len(items))
+
+    def _h_get_pending_demand(self, state, msg):
+        """Resource shapes the scheduler can't currently place — the
+        autoscaler's input (reference: autoscaler v2 reads cluster
+        resource state from the GCS AutoscalerStateService,
+        autoscaler.proto:315)."""
+        with self._lock:
+            demands = [dict(spec.resources) for spec in self._pending]
+            pg_demands = [
+                [dict(b.resources) for b in pg.bundles]
+                for pg in self.placement_groups.values()
+                if pg.state == "PENDING"
+            ]
+            idle_nodes = []
+            for n in self.nodes.values():
+                if not n.alive or n.label == "head":
+                    continue
+                busy = any(
+                    w.node_id == n.node_id and (w.inflight or w.actor_id)
+                    for w in self.workers.values()
+                )
+                if not busy and _fits(n.available, n.total):
+                    idle_nodes.append(n.node_id.binary())
+        state["peer"].reply(
+            msg, ok=True, task_demands=demands, pg_demands=pg_demands,
+            idle_nodes=idle_nodes,
+        )
+
+    def _h_get_task_events(self, state, msg):
+        with self._lock:
+            events = [
+                {
+                    "task_id": tid.hex(),
+                    "name": name,
+                    "event": event,
+                    "timestamp": ts,
+                    "worker_id": wid.hex() if wid else "",
+                }
+                for tid, name, event, ts, wid in self.task_events
+            ]
+        state["peer"].reply(msg, ok=True, events=events)
+
     # ------------------------------------------------------------- node admin
 
     def _h_add_node(self, state, msg):
@@ -801,6 +957,10 @@ class GcsServer:
                            error_blob: Optional[bytes] = None):
         from . import serialization
         from ..exceptions import ActorDiedError, RayTaskError
+
+        # Terminal state-API/timeline event for tasks that fail outside
+        # a worker (worker death, actor death, unschedulable, ...).
+        self._record_task_event(spec.task_id.binary(), spec.name, "FAILED")
 
         if error_blob is None:
             if actor_error is not None:
@@ -956,6 +1116,10 @@ class GcsServer:
                 worker.actor_id = spec.actor_id
             try:
                 worker.conn.send({"type": "execute_task", "spec": spec})
+                self._record_task_event(
+                    spec.task_id.binary(), spec.name, "RUNNING",
+                    worker.worker_id.binary(),
+                )
                 progressed = True
             except ConnectionLost:
                 self._release_task_resources(spec, node.node_id)

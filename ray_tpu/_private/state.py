@@ -1,0 +1,52 @@
+"""GlobalState helpers: timeline export (reference:
+python/ray/_private/state.py — ray.timeline :942 dumps chrome://tracing
+JSON from the GCS task-event store)."""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def task_events() -> List[Dict[str, Any]]:
+    from .worker import global_client
+
+    reply = global_client().request({"type": "get_task_events"})
+    if not reply.get("ok"):
+        raise RuntimeError("get_task_events failed")
+    return reply["events"]
+
+
+def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
+    """Chrome-trace (chrome://tracing / perfetto) export of task
+    execution. RUNNING→FINISHED/FAILED pairs become complete ("X")
+    events laid out per worker."""
+    events = task_events()
+    starts: Dict[str, Dict[str, Any]] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev["event"] == "RUNNING":
+            starts[ev["task_id"]] = ev
+        elif ev["event"] in ("FINISHED", "FAILED"):
+            start = starts.pop(ev["task_id"], None)
+            if start is None:
+                continue
+            trace.append(
+                {
+                    "name": start["name"],
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": start["timestamp"] * 1e6,
+                    "dur": (ev["timestamp"] - start["timestamp"]) * 1e6,
+                    "pid": ev["worker_id"][:8] or "driver",
+                    "tid": ev["worker_id"][:8] or "driver",
+                    "args": {
+                        "task_id": ev["task_id"],
+                        "state": ev["event"],
+                    },
+                }
+            )
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+        return None
+    return trace

@@ -68,6 +68,26 @@ def init(
                 return _global.client
             raise RayTpuError("ray_tpu.init() called twice; shutdown() first")
         RayConfig.initialize(_system_config)
+        if address == "auto":
+            # Connect to the machine's running head via its session file
+            # (written by `ray-tpu start --head`).
+            import json
+            import os as _os
+            import tempfile as _tempfile
+
+            session_file = _os.path.join(
+                _tempfile.gettempdir(), "ray_tpu", "latest_session.json"
+            )
+            try:
+                with open(session_file) as f:
+                    info = json.load(f)
+                address = f"{info['address']}?{info['authkey']}"
+            except (FileNotFoundError, json.JSONDecodeError, KeyError):
+                raise RayTpuError(
+                    "address='auto' but no running head found "
+                    f"({session_file} missing or stale); run "
+                    "`ray-tpu start --head`"
+                ) from None
         if address is None:
             node = Node(
                 default_resources(num_cpus, num_tpus, resources), temp_dir=_temp_dir

@@ -1,0 +1,16 @@
+"""Autoscaler: scale logical nodes to unplaceable demand.
+
+Reference: python/ray/autoscaler/v2 — an instance-manager loop reads
+pending resource demand from the GCS (AutoscalerStateService,
+autoscaler.proto:315), bin-packs it against node types, asks a
+NodeProvider to launch/terminate instances, and downsizes idle nodes.
+The FakeNodeProvider (reference:
+autoscaler/_private/fake_multi_node/node_provider.py) "launches" nodes
+as logical GCS nodes so the full loop is testable in one process; a
+real TPU provider would create pod-slice VMs instead.
+"""
+from __future__ import annotations
+
+from .autoscaler import Autoscaler, NodeProvider, FakeNodeProvider  # noqa: F401
+
+__all__ = ["Autoscaler", "NodeProvider", "FakeNodeProvider"]

@@ -1,0 +1,60 @@
+"""Cluster-in-one-process test harness.
+
+Reference: python/ray/cluster_utils.py:135 — N logical nodes in one
+GCS, so multi-node scheduling/failover tests run in a single CI
+container. ``add_node`` registers a new logical node with its own
+resource pool; ``remove_node`` kills it (and every worker on it).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import ray_tpu
+from ._private.worker import global_client
+
+
+class ClusterNode:
+    def __init__(self, node_id: bytes, resources: Dict[str, float]):
+        self.node_id = node_id
+        self.resources = resources
+
+    def __repr__(self):
+        return f"ClusterNode({self.node_id.hex()[:8]}, {self.resources})"
+
+
+class Cluster:
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[dict] = None,
+    ):
+        self._nodes = []
+        if initialize_head:
+            ray_tpu.init(**(head_node_args or {"num_cpus": 1}),
+                         ignore_reinit_error=True)
+
+    def add_node(self, *, num_cpus: float = 1, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 label: str = "") -> ClusterNode:
+        res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        reply = global_client().request(
+            {"type": "add_node", "resources": res, "label": label}
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(f"add_node failed: {reply}")
+        node = ClusterNode(reply["node_id"], res)
+        self._nodes.append(node)
+        return node
+
+    def remove_node(self, node: ClusterNode) -> None:
+        global_client().request(
+            {"type": "remove_node", "node_id": node.node_id}
+        )
+        if node in self._nodes:
+            self._nodes.remove(node)
+
+    def shutdown(self):
+        ray_tpu.shutdown()

@@ -1,0 +1,243 @@
+"""ray-tpu CLI (reference: python/ray/scripts/scripts.py — ray
+start/stop/status/list/timeline/memory/submit).
+
+    python -m ray_tpu start --head --num-cpus 8   # standalone head
+    python -m ray_tpu status
+    python -m ray_tpu list actors
+    python -m ray_tpu summary tasks
+    python -m ray_tpu timeline -o trace.json
+    python -m ray_tpu memory
+    python -m ray_tpu submit -- python my_job.py
+    python -m ray_tpu stop
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+SESSION_FILE = os.path.join(tempfile.gettempdir(), "ray_tpu",
+                            "latest_session.json")
+
+
+def _connect():
+    import ray_tpu
+
+    ray_tpu.init(address="auto")
+    return ray_tpu
+
+
+def cmd_start(args):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    from ray_tpu._private.worker import _global
+
+    node = _global.node
+    os.makedirs(os.path.dirname(SESSION_FILE), exist_ok=True)
+    tmp = SESSION_FILE + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "address": node.address,
+                "authkey": node.authkey.hex(),
+                "pid": os.getpid(),
+                "session_dir": node.session_dir,
+            },
+            f,
+        )
+    os.replace(tmp, SESSION_FILE)  # atomic: readers never see partial JSON
+    print(f"ray_tpu head started: {node.address}")
+    print(f"session file: {SESSION_FILE}")
+    print("connect with: ray_tpu.init(address='auto')")
+    stop = [False]
+
+    def on_term(*_):
+        stop[0] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop[0]:
+            time.sleep(0.5)
+    finally:
+        try:
+            os.unlink(SESSION_FILE)
+        except FileNotFoundError:
+            pass
+        ray_tpu.shutdown()
+        print("head stopped")
+
+
+def cmd_stop(args):
+    try:
+        with open(SESSION_FILE) as f:
+            info = json.load(f)
+    except FileNotFoundError:
+        print("no running head")
+        return
+    try:
+        os.kill(info["pid"], signal.SIGTERM)
+        print(f"sent SIGTERM to head pid {info['pid']}")
+    except ProcessLookupError:
+        print("head already gone")
+        try:
+            os.unlink(SESSION_FILE)
+        except FileNotFoundError:
+            pass
+
+
+def cmd_status(args):
+    ray_tpu = _connect()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    from ray_tpu.util.state import list_nodes, list_workers
+
+    nodes = list_nodes()
+    workers = list_workers()
+    print("== Cluster status ==")
+    for k in sorted(total):
+        print(f"  {avail.get(k, 0):g}/{total[k]:g} {k}")
+    print(f"  nodes: {sum(1 for n in nodes if n['alive'])} alive"
+          f" / {len(nodes)} total")
+    print(f"  workers: {len(workers)}")
+
+
+def _print_table(items, columns):
+    if not items:
+        print("(none)")
+        return
+    widths = {
+        c: max(len(c), *(len(str(i.get(c, ""))) for i in items))
+        for c in columns
+    }
+    print("  ".join(c.ljust(widths[c]) for c in columns))
+    for i in items:
+        print("  ".join(str(i.get(c, "")).ljust(widths[c]) for c in columns))
+
+
+def cmd_list(args):
+    _connect()
+    from ray_tpu.util import state as state_api
+
+    fn = getattr(state_api, f"list_{args.kind}")
+    items = fn(limit=args.limit)
+    columns = {
+        "actors": ["actor_id", "name", "state", "class_name"],
+        "tasks": ["task_id", "name", "state", "worker_id"],
+        "nodes": ["node_id", "alive", "label", "total"],
+        "workers": ["worker_id", "state", "pid", "num_inflight"],
+        "objects": ["object_id", "status", "size", "inline"],
+        "placement_groups": ["placement_group_id", "state", "strategy"],
+    }[args.kind]
+    _print_table(items, columns)
+
+
+def cmd_summary(args):
+    _connect()
+    from ray_tpu.util.state import summarize_tasks
+
+    print(json.dumps(summarize_tasks(), indent=2))
+
+
+def cmd_timeline(args):
+    _connect()
+    from ray_tpu._private.state import timeline
+
+    timeline(args.output)
+    print(f"wrote {args.output} (open in chrome://tracing or perfetto)")
+
+
+def cmd_memory(args):
+    _connect()
+    from ray_tpu.util.state import list_objects
+
+    items = list_objects(limit=args.limit)
+    total = sum(i["size"] for i in items)
+    _print_table(items, ["object_id", "status", "size", "inline"])
+    print(f"total: {len(items)} objects, {total / 1e6:.1f} MB")
+
+
+def cmd_metrics(args):
+    _connect()
+    from ray_tpu.util.metrics import get_metrics_snapshot
+
+    print(json.dumps(get_metrics_snapshot(), indent=2))
+
+
+def cmd_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    parts = args.entrypoint
+    if parts and parts[0] == "--":  # argparse REMAINDER keeps the separator
+        parts = parts[1:]
+    entrypoint = " ".join(parts)
+    job_id = client.submit_job(entrypoint=entrypoint)
+    print(f"submitted {job_id}: {entrypoint}")
+    if args.wait:
+        status = client.wait_until_finish(job_id)
+        print(client.get_job_logs(job_id), end="")
+        print(f"job {job_id}: {status.value}")
+        sys.exit(0 if status.value == "SUCCEEDED" else 1)
+
+
+def cmd_jobs(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    _print_table(client.list_jobs(), ["job_id", "status", "entrypoint"])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a standalone head")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--num-cpus", type=int, default=os.cpu_count())
+    sp.add_argument("--num-tpus", type=int, default=None)
+    sp.set_defaults(fn=cmd_start)
+
+    sub.add_parser("stop", help="stop the head").set_defaults(fn=cmd_stop)
+    sub.add_parser("status", help="cluster status").set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["actors", "tasks", "nodes", "workers",
+                                     "objects", "placement_groups"])
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="summarize tasks")
+    sp.add_argument("kind", choices=["tasks"])
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="dump chrome trace")
+    sp.add_argument("-o", "--output", default="ray_tpu_timeline.json")
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("memory", help="object store contents")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_memory)
+
+    sub.add_parser("metrics", help="metrics snapshot").set_defaults(
+        fn=cmd_metrics
+    )
+
+    sp = sub.add_parser("submit", help="submit a job")
+    sp.add_argument("--wait", action="store_true")
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_submit)
+
+    sub.add_parser("jobs", help="list jobs").set_defaults(fn=cmd_jobs)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

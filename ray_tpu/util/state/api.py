@@ -1,0 +1,62 @@
+"""State API implementation."""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+
+def _list(kind: str, limit: int = 1000,
+          filters: Optional[List[tuple]] = None) -> List[Dict[str, Any]]:
+    from ..._private.worker import global_client
+
+    for f in filters or []:
+        if f[1] not in ("=", "!="):
+            raise ValueError(f"unsupported filter op {f[1]!r}")
+    # Filters apply server-side BEFORE the limit truncation so matches
+    # beyond `limit` aren't silently dropped.
+    reply = global_client().request(
+        {"type": "list_state", "kind": kind, "limit": limit,
+         "filters": [list(f) for f in filters or []]}
+    )
+    if not reply.get("ok"):
+        raise RuntimeError(f"list_state({kind}) failed: {reply.get('error')}")
+    return reply["items"]
+
+
+def list_actors(filters=None, limit: int = 1000):
+    return _list("actors", limit, filters)
+
+
+def list_tasks(filters=None, limit: int = 1000):
+    return _list("tasks", limit, filters)
+
+
+def list_nodes(filters=None, limit: int = 1000):
+    return _list("nodes", limit, filters)
+
+
+def list_workers(filters=None, limit: int = 1000):
+    return _list("workers", limit, filters)
+
+
+def list_objects(filters=None, limit: int = 1000):
+    return _list("objects", limit, filters)
+
+
+def list_placement_groups(filters=None, limit: int = 1000):
+    return _list("placement_groups", limit, filters)
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Per-function-name counts by state (reference:
+    util/state/api.py summarize_tasks:1365)."""
+    tasks = _list("tasks", limit=100_000)
+    by_func: Dict[str, Counter] = {}
+    for t in tasks:
+        by_func.setdefault(t["name"], Counter())[t["state"]] += 1
+    return {
+        "total": len(tasks),
+        "by_func_name": {
+            name: dict(states) for name, states in sorted(by_func.items())
+        },
+    }

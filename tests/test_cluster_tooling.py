@@ -1,0 +1,197 @@
+"""State API, timeline, metrics, cluster harness, jobs, autoscaler, CLI.
+
+Models the reference's python/ray/tests coverage of util/state,
+ray.timeline, util/metrics, cluster_utils, job submission, and the
+autoscaler fake-provider loop.
+"""
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_state_api_lists(cluster):
+    from ray_tpu.util.state import (
+        list_actors,
+        list_nodes,
+        list_tasks,
+        list_workers,
+        summarize_tasks,
+    )
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="state_test_actor").remote()
+    ray_tpu.get(a.ping.remote())
+    ray_tpu.get([f.remote(i) for i in range(5)])
+
+    actors = list_actors()
+    assert any(x["name"] == "state_test_actor" for x in actors)
+    nodes = list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+    workers = list_workers()
+    assert len(workers) >= 1
+    tasks = list_tasks()
+    f_tasks = [t for t in tasks if t["name"] == "f"]
+    assert len(f_tasks) == 5
+    assert all(t["state"] == "FINISHED" for t in f_tasks)
+    summary = summarize_tasks()
+    assert summary["by_func_name"]["f"]["FINISHED"] == 5
+
+
+def test_timeline_export(cluster, tmp_path):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([slow.remote() for _ in range(4)])
+    out = tmp_path / "trace.json"
+    ray_tpu.timeline(str(out))
+    trace = json.loads(out.read_text())
+    spans = [t for t in trace if t["name"] == "slow"]
+    assert len(spans) == 4
+    assert all(t["ph"] == "X" and t["dur"] >= 50_000 * 0.5 for t in spans)
+
+
+def test_metrics_counter_gauge(cluster):
+    from ray_tpu.util.metrics import Counter, Gauge, get_metrics_snapshot
+
+    c = Counter("test_requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = Gauge("test_qsize")
+    g.set(7.0)
+    snap = get_metrics_snapshot()
+    series = {tuple(s["tags"].items()): s["value"]
+              for s in snap["test_requests"]["series"]}
+    assert series[(("route", "/a"),)] == 3.0
+    assert snap["test_qsize"]["series"][0]["value"] == 7.0
+
+
+def test_cluster_add_remove_node(cluster):
+    c = Cluster(initialize_head=False)
+    node = c.add_node(num_cpus=2, resources={"special": 1})
+    assert ray_tpu.cluster_resources().get("special") == 1.0
+
+    @ray_tpu.remote(resources={"special": 1})
+    def on_special():
+        return "ran"
+
+    assert ray_tpu.get(on_special.remote()) == "ran"
+    c.remove_node(node)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if "special" not in {
+            k
+            for n in ray_tpu.nodes()
+            if n["alive"]
+            for k in n["total"]
+        }:
+            break
+        time.sleep(0.1)
+
+
+def test_job_submission(cluster):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job ran ok')\""
+    )
+    status = client.wait_until_finish(job_id, timeout_s=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "job ran ok" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finish(bad, timeout_s=60) == JobStatus.FAILED
+
+
+def test_autoscaler_scales_up_and_down(cluster):
+    from ray_tpu.autoscaler import Autoscaler
+
+    scaler = Autoscaler(
+        {"cpu_worker": {"resources": {"CPU": 2, "scale": 2}, "max_workers": 3}},
+        idle_timeout_s=2.0,
+        interval_s=0.2,
+    )
+    scaler.start()
+    try:
+        # Demand needing the custom resource only autoscaled nodes have.
+        @ray_tpu.remote(resources={"scale": 1})
+        def burst(i):
+            time.sleep(0.3)
+            return i
+
+        refs = [burst.remote(i) for i in range(6)]
+        assert sorted(ray_tpu.get(refs, timeout=90)) == list(range(6))
+        assert scaler.num_launches >= 1
+        # Idle nodes terminate after the timeout.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if scaler.num_terminations >= scaler.num_launches:
+                break
+            time.sleep(0.25)
+        assert scaler.num_terminations >= 1
+    finally:
+        scaler.stop()
+
+
+def test_cli_status_and_list(tmp_path):
+    """Drive the CLI against a standalone head (start → status → list →
+    stop), exercising the session file + address='auto' path."""
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head", "--num-cpus", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            r = subprocess.run(
+                [sys.executable, "-m", "ray_tpu", "status"],
+                env=env, capture_output=True, text=True, timeout=60,
+            )
+            if r.returncode == 0 and "Cluster status" in r.stdout:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("CLI status never succeeded")
+        r = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "list", "nodes"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0 and "node_id" in r.stdout
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "stop"], env=env,
+            capture_output=True, timeout=30,
+        )
+        try:
+            head.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            head.kill()
