@@ -1,0 +1,114 @@
+"""GPT-2/NeoX-style causal LM in flax (second dense family alongside
+Llama): LayerNorm (with bias), learned position embeddings, GELU MLP,
+standard MHA. Same GSPMD sharding conventions as llama.py
+(parallel.mesh.spec_for_param + activation constraints).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+from ..parallel.mesh import with_logical_constraint
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None  # default 4x hidden
+    max_seq_len: int = 1024
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        h, l, v = self.hidden_size, self.num_layers, self.vocab_size
+        per_layer = 4 * h * h + 2 * h * self.mlp_dim
+        return l * per_layer + v * h + self.max_seq_len * h
+
+
+CONFIGS = {
+    "gpt2-tiny": GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                           num_heads=4, max_seq_len=256),
+    "gpt2": GPTConfig(),
+    "gpt2-medium": GPTConfig(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt2-large": GPTConfig(hidden_size=1280, num_layers=36, num_heads=20),
+}
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+        )
+        h = ln("ln_1")(x)
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, cfg.head_dim), axis=-1, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="c_attn",
+        )(h)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        o = flash_attention(q, k, v, causal=True).transpose(0, 2, 1, 3)
+        attn_out = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="c_proj",
+        )(o)
+        x = x + attn_out
+        h = ln("ln_2")(x)
+        m = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="c_fc")(h)
+        m = nn.gelu(m)
+        m = with_logical_constraint(m, ("batch", "seq", "mlp"))
+        m = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="c_proj_mlp")(m)
+        x = x + m
+        return with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class GPTForCausalLM(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(input_ids.shape[1])[None], input_ids.shape
+            )
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wte")
+        pos = nn.Embed(cfg.max_seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wpe")
+        x = tok(input_ids) + pos(positions)
+        x = with_logical_constraint(x, ("batch", "seq", "embed"))
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(
+                Block, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        return tok.attend(x.astype(cfg.param_dtype))

@@ -1,0 +1,207 @@
+"""Mixtral-style sparse-MoE causal LM with expert parallelism.
+
+Net-new vs the reference (SURVEY.md §2.3: EP/MoE "absent — integration
+delegated"; here it's first-class). TPU-first design: experts live in
+one stacked tensor with logical axis "expert" → the `expert` mesh axis,
+and token dispatch/combine are dense einsums against a capacity-bounded
+one-hot dispatch mask (GShard-style). Under GSPMD, batch-sharded
+activations meeting expert-sharded weights compile into the all-to-all
+over ICI automatically — no hand-written routing collectives, static
+shapes throughout (XLA-friendly: no ragged tensors).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel import with_logical_constraint
+from .llama import CONFIGS as LLAMA_CONFIGS
+from .llama import Attention, LlamaConfig, RMSNorm, causal_lm_loss  # noqa: F401
+
+
+@dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    num_experts_per_tok: int = 2  # top-k routing
+    # Per-expert token capacity = capacity_factor * T * k / E.
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.02
+
+    def num_params(self) -> int:
+        """Llama count minus its dense MLP, plus E stacked experts and
+        the router (LlamaConfig.num_params would undercount the FFN by
+        ~E x)."""
+        h, i, l = self.hidden_size, self.intermediate_size, self.num_layers
+        dense_mlp = 3 * h * i
+        moe_mlp = self.num_experts * 3 * h * i + h * self.num_experts
+        return super().num_params() + l * (moe_mlp - dense_mlp)
+
+    def active_params_per_token(self) -> int:
+        """FLOPs-relevant parameter count: only top-k experts run per
+        token (what an MFU estimate should use)."""
+        h, i, l = self.hidden_size, self.intermediate_size, self.num_layers
+        dense_mlp = 3 * h * i
+        active_mlp = self.num_experts_per_tok * 3 * h * i + h * self.num_experts
+        return super().num_params() + l * (active_mlp - dense_mlp)
+
+
+CONFIGS = {
+    "mixtral-tiny": MixtralConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, num_experts=4, num_experts_per_tok=2,
+        max_seq_len=256,
+    ),
+    "mixtral-small": MixtralConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=3584,
+        num_layers=8, num_heads=16, num_kv_heads=8, num_experts=8,
+        num_experts_per_tok=2, max_seq_len=4096,
+    ),
+}
+
+
+class MoELayer(nn.Module):
+    """Top-k router + capacity-bounded dense dispatch/combine."""
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, T, D = x.shape
+        E, K = cfg.num_experts, cfg.num_experts_per_tok
+        C = max(1, int(cfg.capacity_factor * T * K / E))
+
+        router = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32,
+            param_dtype=cfg.param_dtype, name="router",
+        )
+        logits = router(x.astype(jnp.float32))  # [B, T, E] — fp32 routing
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # Top-k gates, renormalized over the chosen experts.
+        gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [B, T, K]
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9
+        )
+
+        # Capacity-bounded one-hot dispatch mask [B, T, E, C]: position
+        # within each expert's buffer assigned by arrival order; tokens
+        # past capacity are dropped (their gate contribution vanishes).
+        onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [B,T,K,E]
+        expert_mask = onehot.sum(2)  # [B, T, E] (0/1 per expert)
+        position = (
+            jnp.cumsum(expert_mask, axis=1) - expert_mask
+        )  # tokens before me per expert
+        in_cap = (position < C) * expert_mask
+        pos_onehot = jax.nn.one_hot(
+            position.astype(jnp.int32), C, dtype=jnp.float32
+        )
+        dispatch = in_cap[..., None] * pos_onehot  # [B, T, E, C]
+        gates = (onehot * gate_vals[..., None]).sum(2)  # [B, T, E]
+        combine = gates[..., None] * dispatch  # [B, T, E, C]
+
+        # Aux load-balance loss (Switch Transformer eq. 4): mean gate
+        # fraction x mean dispatch fraction per expert.
+        frac_tokens = expert_mask.mean(axis=(0, 1))
+        frac_probs = probs.mean(axis=(0, 1))
+        aux = E * jnp.sum(frac_tokens * frac_probs)
+        self.sow("intermediates", "router_aux_loss", aux)
+
+        # Dispatch: [B,T,D] x [B,T,E,C] -> [E, B, C, D]; under GSPMD the
+        # expert axis of the result is mesh-sharded (all-to-all on ICI).
+        xd = x.astype(cfg.dtype)
+        expert_in = jnp.einsum("btd,btec->ebcd", xd, dispatch.astype(cfg.dtype))
+        expert_in = with_logical_constraint(
+            expert_in, ("expert", "batch", None, "embed")
+        )
+
+        # Stacked expert FFN (SwiGLU like the dense path). E-major
+        # weights; parallel.mesh.spec_for_param shards them
+        # P("expert", "fsdp"/"tensor", ...) by name.
+        def pvar(name, shape):
+            return self.param(
+                name, nn.initializers.lecun_normal(), shape, cfg.param_dtype
+            )
+
+        w_gate = pvar("w_gate", (E, D, cfg.intermediate_size))
+        w_up = pvar("w_up", (E, D, cfg.intermediate_size))
+        w_down = pvar("w_down", (E, cfg.intermediate_size, D))
+        h = jnp.einsum("ebcd,edf->ebcf", expert_in, w_gate.astype(cfg.dtype))
+        u = jnp.einsum("ebcd,edf->ebcf", expert_in, w_up.astype(cfg.dtype))
+        act = nn.silu(h) * u
+        expert_out = jnp.einsum("ebcf,efd->ebcd", act, w_down.astype(cfg.dtype))
+
+        # Combine back to token order, weighted by gates.
+        out = jnp.einsum(
+            "ebcd,btec->btd", expert_out, combine.astype(cfg.dtype)
+        )
+        return with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+class MoEDecoderLayer(nn.Module):
+    cfg: MixtralConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        h = x + Attention(cfg, mesh=self.mesh, name="attn")(
+            RMSNorm(cfg.rms_eps, cfg.param_dtype, name="input_norm")(x),
+            positions,
+        )
+        out = h + MoELayer(cfg, name="moe")(
+            RMSNorm(cfg.rms_eps, cfg.param_dtype, name="post_attn_norm")(h)
+        )
+        return with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+class MixtralForCausalLM(nn.Module):
+    cfg: MixtralConfig
+    mesh: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(input_ids.shape[1])[None], input_ids.shape
+            )
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="embed_tokens",
+        )
+        x = emb(input_ids)
+        x = with_logical_constraint(x, ("batch", "seq", "embed"))
+        layer_cls = MoEDecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                MoEDecoderLayer, prevent_cse=False,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, mesh=self.mesh, name=f"layers_{i}")(x, positions)
+        x = RMSNorm(cfg.rms_eps, cfg.param_dtype, name="final_norm")(x)
+        logits = emb.attend(x.astype(cfg.param_dtype))
+        return logits
+
+
+def moe_lm_loss(model: MixtralForCausalLM, params, input_ids, targets,
+                mask=None):
+    """Causal LM loss + router aux loss (call instead of apply+loss so
+    the sown aux terms are collected)."""
+    logits, state = model.apply(
+        params, input_ids, mutable=["intermediates"]
+    )
+    loss = causal_lm_loss(logits, targets, mask)
+    aux_terms = jax.tree_util.tree_leaves(
+        state.get("intermediates", {})
+    )
+    if aux_terms:
+        loss = loss + model.cfg.router_aux_loss_coef * (
+            sum(aux_terms) / len(aux_terms)
+        )
+    return loss

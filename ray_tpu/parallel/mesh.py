@@ -173,6 +173,13 @@ def spec_for_param(path: Tuple[str, ...], shape: Tuple[int, ...]) -> P:
         return P("tensor", "fsdp")
     if len(shape) == 2:
         return P("fsdp", "tensor")
+    if len(shape) == 3 and ("expert" in name or "w_gate" in name
+                            or "w_up" in name or "w_down" in name):
+        # Stacked MoE expert weights [E, in, out]: expert-parallel first
+        # axis, then the usual (fsdp, tensor) matmul split.
+        if "w_down" in name:
+            return P("expert", "tensor", "fsdp")
+        return P("expert", "fsdp", "tensor")
     if len(shape) == 3:  # e.g. (heads, head_dim, embed) attention proj
         return P("tensor", None, "fsdp")
     return P(*([None] * len(shape)))

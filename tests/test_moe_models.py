@@ -1,0 +1,163 @@
+"""MoE (expert parallelism) + GPT model family tests on the CPU mesh.
+
+Runs under the conftest's 8-virtual-device CPU backend.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def tiny_moe():
+    from ray_tpu.models.mixtral import CONFIGS, MixtralForCausalLM
+
+    cfg = CONFIGS["mixtral-tiny"]
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32, remat=False)
+    model = MixtralForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (4, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    return cfg, model, ids, params
+
+
+def test_moe_forward_finite(tiny_moe):
+    cfg, model, ids, params = tiny_moe
+    logits = model.apply(params, ids)
+    assert logits.shape == (4, 32, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_moe_dispatch_matches_naive_gather(tiny_moe):
+    """The dense dispatch/combine einsums must equal a per-token gather
+    reference (same experts, same gates, no capacity drops)."""
+    import dataclasses
+
+    from ray_tpu.models.mixtral import MoELayer
+
+    cfg, _, _, _ = tiny_moe
+    # Huge capacity so nothing is dropped in the comparison.
+    cfg = dataclasses.replace(cfg, capacity_factor=10.0)
+    layer = MoELayer(cfg)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 16, cfg.hidden_size), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(1), x)
+    out = layer.apply(params, x)
+
+    # Naive reference: per-token top-k gather through each expert's FFN.
+    p = params["params"]
+    router_w = np.asarray(p["router"]["kernel"], np.float64)
+    wg = np.asarray(p["w_gate"], np.float64)
+    wu = np.asarray(p["w_up"], np.float64)
+    wd = np.asarray(p["w_down"], np.float64)
+    xs = np.asarray(x, np.float64)
+    B, T, D = xs.shape
+    logits = xs @ router_w
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.zeros_like(xs)
+    for b in range(B):
+        for t in range(T):
+            topk = np.argsort(-probs[b, t])[: cfg.num_experts_per_tok]
+            gates = probs[b, t, topk]
+            gates = gates / gates.sum()
+            acc = np.zeros(D)
+            for gate, e in zip(gates, topk):
+                h = xs[b, t] @ wg[e]
+                u = xs[b, t] @ wu[e]
+                silu = h / (1 + np.exp(-h))
+                acc += gate * ((silu * u) @ wd[e])
+            want[b, t] = acc
+    np.testing.assert_allclose(np.asarray(out), want, atol=2e-3, rtol=2e-3)
+
+
+def test_moe_capacity_drops_tokens(tiny_moe):
+    """With capacity 0-ish, combine weights vanish: output ≈ 0."""
+    import dataclasses
+
+    from ray_tpu.models.mixtral import MoELayer
+
+    cfg, _, _, _ = tiny_moe
+    cfg = dataclasses.replace(cfg, capacity_factor=1e-9)
+    layer = MoELayer(cfg)
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 16, cfg.hidden_size),
+                    jnp.float32)
+    params = layer.init(jax.random.PRNGKey(2), x)
+    out = layer.apply(params, x)
+    # Capacity C=max(1, ...)=1: only the first token per expert survives.
+    per_token = np.abs(np.asarray(out)).sum(-1)
+    assert (per_token[:, -1] == 0).all() or per_token[:, -1].max() < 1e-6
+
+
+def test_moe_train_step_on_expert_mesh(tiny_moe):
+    """Full train step with an expert-parallel mesh axis: GSPMD compiles
+    the dispatch all-to-all; loss is finite and params update."""
+    import optax
+
+    from ray_tpu.models.mixtral import moe_lm_loss
+    from ray_tpu.parallel import MeshSpec, shard_params
+
+    cfg, model, ids, params = tiny_moe
+    mesh = MeshSpec(data=2, expert=4).build()
+    targets = jnp.roll(ids, -1, axis=1)
+    with jax.set_mesh(mesh):
+        params_s = shard_params(params, mesh)
+        tx = optax.adam(1e-3)
+        opt_state = tx.init(params_s)
+
+        @jax.jit
+        def step(params, opt_state):
+            loss, grads = jax.value_and_grad(
+                lambda p: moe_lm_loss(model, p, ids, targets)
+            )(params)
+            updates, opt_state = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        p1, opt_state, loss1 = step(params_s, opt_state)
+        p2, _, loss2 = step(p1, opt_state)
+    assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
+    assert float(loss2) < float(loss1)  # aux+LM loss decreasing on same batch
+    # Expert weights actually sharded over the expert axis.
+    w = p1["params"]["layers_0"]["moe"]["w_gate"]
+    spec = w.sharding.spec
+    assert spec[0] == "expert", f"expert axis not sharded: {spec}"
+
+
+def test_moe_aux_loss_balances(tiny_moe):
+    """Router aux loss = E * sum_e(frac_tokens_e * frac_probs_e); for a
+    near-uniform router at init, frac_tokens sums to K and frac_probs
+    to 1, so the expected value is ~K (= num_experts_per_tok)."""
+    cfg, model, ids, params = tiny_moe
+    K = cfg.num_experts_per_tok
+    _, state = model.apply(params, ids, mutable=["intermediates"])
+    leaves = jax.tree_util.tree_leaves(state["intermediates"])
+    assert leaves, "router_aux_loss not sown"
+    for aux in leaves:
+        assert 0.5 * K < float(aux) < 2.0 * K
+
+
+def test_gpt_forward_and_grads():
+    import dataclasses
+
+    from ray_tpu.models.gpt import CONFIGS, GPTForCausalLM
+    from ray_tpu.models.llama import causal_lm_loss
+
+    cfg = dataclasses.replace(CONFIGS["gpt2-tiny"], dtype=jnp.float32,
+                              remat=False)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    logits = model.apply(params, ids)
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    loss, grads = jax.value_and_grad(
+        lambda p: causal_lm_loss(model.apply(p, ids), jnp.roll(ids, -1, 1))
+    )(params)
+    assert np.isfinite(float(loss))
+    gnorm = sum(
+        float(jnp.abs(g).sum()) for g in jax.tree_util.tree_leaves(grads)
+    )
+    assert gnorm > 0
