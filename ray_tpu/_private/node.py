@@ -7,7 +7,6 @@ subprocesses; the Node owns the session dir and shutdown.
 """
 from __future__ import annotations
 
-import glob
 import os
 import secrets
 import shutil
@@ -19,22 +18,11 @@ from .gcs import GcsServer
 
 
 def detect_num_tpu_chips() -> int:
-    """TPU chip detection (reference: _private/accelerators/tpu.py:98-117 —
-    /dev/accel* for GCE, /dev/vfio for GKE; env override first)."""
-    env = os.environ.get("RAY_TPU_NUM_CHIPS")
-    if env is not None:
-        return int(env)
-    chips = glob.glob("/dev/accel*")
-    if chips:
-        return len(chips)
-    try:
-        vfio = glob.glob("/dev/vfio/*")
-        chips = [p for p in vfio if os.path.basename(p).isdigit()]
-        if chips:
-            return len(chips)
-    except OSError:
-        pass
-    return 0
+    """TPU chip detection — delegated to the accelerator manager
+    (reference: _private/accelerators/tpu.py:98-117)."""
+    from .accelerators import TPUAcceleratorManager
+
+    return TPUAcceleratorManager.get_current_node_num_accelerators()
 
 
 def default_resources(
@@ -48,6 +36,12 @@ def default_resources(
     tpus = num_tpus if num_tpus is not None else detect_num_tpu_chips()
     if tpus:
         out["TPU"] = float(tpus)
+        # Gang-placement synthetics: TPU-{type}-head on pod worker 0,
+        # a shared pod-name resource on every host (reference:
+        # accelerators/tpu.py:334).
+        from .accelerators import TPUAcceleratorManager
+
+        out.update(TPUAcceleratorManager.get_current_node_additional_resources())
     if resources:
         out.update({k: float(v) for k, v in resources.items()})
     return out

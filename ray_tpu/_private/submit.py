@@ -70,3 +70,13 @@ def resources_from_options(opts: Dict[str, Any], is_actor: bool = False) -> Dict
 
 def pickle_by_value(obj: Any) -> bytes:
     return cloudpickle.dumps(obj)
+
+
+def prepare_runtime_env(runtime_env, client):
+    """Validate + package a runtime_env at submission time (local dirs
+    become content-addressed KV URIs; see runtime_env.package)."""
+    if not runtime_env:
+        return None
+    from . import runtime_env as _re
+
+    return _re.package(runtime_env, client)

@@ -19,6 +19,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -72,8 +73,18 @@ class WorkerRuntime:
     # -------------------------------------------------------------- execute
 
     def _run_user_code(self, spec: TaskSpec):
-        args, kwargs = self._resolve_args(spec)
+        from . import runtime_env as _re
+
         if spec.actor_creation:
+            # Actor runtime envs activate for the actor's whole life
+            # (the env stack is entered and never popped; the worker is
+            # dedicated to this actor from here on). Entered BEFORE
+            # deserialization so code shipped via py_modules/working_dir
+            # resolves (functions pickled by reference need sys.path).
+            if spec.runtime_env:
+                self._actor_env = _re.activate(spec.runtime_env, self.client)
+                self._actor_env.__enter__()
+            args, kwargs = self._resolve_args(spec)
             cls = self._resolve_function(spec)
             self.actor_instance = cls(*args, **kwargs)
             self.actor_id = spec.actor_id.binary()
@@ -89,6 +100,7 @@ class WorkerRuntime:
                 self._done.set()
                 self.task_queue.put(None)
                 return None
+            args, kwargs = self._resolve_args(spec)
             if spec.method_name == "__ray_apply__":
                 # Apply a shipped function to the actor instance
                 # (compiled-graph loops, introspection) — the function
@@ -96,7 +108,32 @@ class WorkerRuntime:
                 fn = cloudpickle.loads(args[0])
                 return fn(self.actor_instance, *args[1:], **kwargs)
             method = getattr(self.actor_instance, spec.method_name)
+            from ..util import tracing
+
+            if tracing.enabled():
+                # Actor-method span, parented to the actor's creation
+                # context (per-call caller context isn't carried).
+                ctx = tracing.new_context(spec.name)
+                t0 = time.time()
+                result = method(*args, **kwargs)
+                tracing.record_span(spec.name, t0, time.time(), ctx)
+                return result
             return method(*args, **kwargs)
+        if spec.runtime_env:
+            with _re.activate(spec.runtime_env, self.client):
+                args, kwargs = self._resolve_args(spec)
+                fn = self._resolve_function(spec)
+                from ..util import tracing
+
+                if tracing.enabled():
+                    t0 = time.time()
+                    result = fn(*args, **kwargs)
+                    tracing.record_span(
+                        spec.name, t0, time.time(), tracing.current_context()
+                    )
+                    return result
+                return fn(*args, **kwargs)
+        args, kwargs = self._resolve_args(spec)
         fn = self._resolve_function(spec)
         return fn(*args, **kwargs)
 

@@ -16,6 +16,12 @@ from ._private.task_spec import TaskSpec
 from ._private.worker import global_client
 from .object_ref import ObjectRef
 
+def _maybe_trace(runtime_env, name):
+    from .util import tracing
+
+    return tracing.inject(runtime_env, name)
+
+
 _VALID_ACTOR_OPTIONS = {
     "num_cpus",
     "num_gpus",
@@ -196,7 +202,12 @@ class ActorClass:
             placement_group_bundle_index=(
                 bundle_index if bundle_index is not None else -1
             ),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_submit.prepare_runtime_env(
+                _maybe_trace(
+                    opts.get("runtime_env"), f"{self._cls.__name__}.__init__"
+                ),
+                client,
+            ),
         )
         client.submit(spec)
         return ActorHandle(actor_id, self._function_id)

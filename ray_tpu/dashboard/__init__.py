@@ -1,0 +1,121 @@
+"""Dashboard: HTTP view of cluster state.
+
+Reference: dashboard/ (aiohttp head process serving a React frontend +
+JSON APIs fed by the GCS and agents). Scoped-down equivalent: one
+aiohttp actor serving the state API as JSON under /api/* plus a
+self-contained HTML overview — the data pipeline (GCS task events →
+state API) is the same one the reference's dashboard rides.
+
+    from ray_tpu.dashboard import start_dashboard
+    url = start_dashboard(port=8265)
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
+ h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
+ table { border-collapse: collapse; margin-top: .5rem; }
+ td, th { border: 1px solid #ccc; padding: .25rem .6rem; font-size: .85rem; }
+ th { background: #f3f3f3; text-align: left; }
+ code { background: #f6f6f6; padding: 0 .25rem; }
+</style></head>
+<body>
+<h1>ray_tpu dashboard</h1>
+<div id="root">loading…</div>
+<script>
+const KINDS = ["nodes", "workers", "actors", "tasks", "placement_groups"];
+async function refresh() {
+  const root = document.getElementById("root");
+  let html = "";
+  const res = await fetch("/api/cluster"); const cluster = await res.json();
+  html += "<h2>Resources</h2><table><tr><th>resource</th><th>available</th><th>total</th></tr>";
+  for (const k of Object.keys(cluster.total).sort())
+    html += `<tr><td>${k}</td><td>${cluster.available[k] ?? 0}</td><td>${cluster.total[k]}</td></tr>`;
+  html += "</table>";
+  for (const kind of KINDS) {
+    const r = await fetch(`/api/${kind}`); const items = await r.json();
+    html += `<h2>${kind} (${items.length})</h2>`;
+    if (!items.length) { html += "<p>(none)</p>"; continue; }
+    const cols = Object.keys(items[0]);
+    html += "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
+    for (const it of items.slice(0, 50))
+      html += "<tr>" + cols.map(c => `<td>${JSON.stringify(it[c])}</td>`).join("") + "</tr>";
+    html += "</table>";
+  }
+  root.innerHTML = html;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class DashboardActor:
+    def __init__(self, host: str, port: int):
+        self._host = host
+        self._port = port
+        self._runner = None
+
+    async def ready(self) -> str:
+        if self._runner is not None:
+            return f"http://{self._host}:{self._port}"
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/cluster", self._cluster)
+        app.router.add_get("/api/{kind}", self._list)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        return f"http://{self._host}:{self._port}"
+
+    async def _index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    async def _cluster(self, request):
+        from aiohttp import web
+
+        import ray_tpu
+
+        return web.json_response(
+            {
+                "total": ray_tpu.cluster_resources(),
+                "available": ray_tpu.available_resources(),
+            }
+        )
+
+    async def _list(self, request):
+        from aiohttp import web
+
+        from ..util import state as state_api
+
+        kind = request.match_info["kind"]
+        fn = getattr(state_api, f"list_{kind}", None)
+        if fn is None:
+            return web.Response(status=404, text=f"unknown kind {kind}")
+        return web.json_response(fn(limit=500))
+
+    async def shutdown(self):
+        if self._runner:
+            await self._runner.cleanup()
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> str:
+    """Start (or find) the dashboard actor; returns its URL."""
+    import ray_tpu
+
+    actor = (
+        ray_tpu.remote(DashboardActor)
+        .options(name="RAY_TPU_DASHBOARD", max_concurrency=16,
+                 get_if_exists=True, num_cpus=0)
+        .remote(host, port)
+    )
+    return ray_tpu.get(actor.ready.remote())

@@ -27,6 +27,14 @@ _VALID_OPTIONS = {
 }
 
 
+def _maybe_trace(runtime_env, task_name):
+    """Inject span context when RAY_TPU_TRACE=1 (reference:
+    tracing_helper.py _tracing_task_invocation)."""
+    from .util import tracing
+
+    return tracing.inject(runtime_env, task_name)
+
+
 class RemoteFunction:
     def __init__(self, fn, **default_options):
         bad = set(default_options) - _VALID_OPTIONS
@@ -94,7 +102,11 @@ class RemoteFunction:
             placement_group_bundle_index=(
                 bundle_index if bundle_index is not None else -1
             ),
-            runtime_env=opts.get("runtime_env"),
+            runtime_env=_submit.prepare_runtime_env(
+                _maybe_trace(opts.get("runtime_env"),
+                             opts.get("name") or self._fn.__name__),
+                client,
+            ),
         )
         refs = client.submit(spec)
         return refs[0] if num_returns == 1 else refs

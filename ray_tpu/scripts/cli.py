@@ -169,6 +169,14 @@ def cmd_metrics(args):
     print(json.dumps(get_metrics_snapshot(), indent=2))
 
 
+def cmd_dashboard(args):
+    _connect()
+    from ray_tpu.dashboard import start_dashboard
+
+    url = start_dashboard(port=args.port)
+    print(f"dashboard running at {url} (actor lives in the cluster)")
+
+
 def cmd_submit(args):
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -227,6 +235,10 @@ def main(argv=None):
     sub.add_parser("metrics", help="metrics snapshot").set_defaults(
         fn=cmd_metrics
     )
+
+    sp = sub.add_parser("dashboard", help="start the dashboard")
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("submit", help="submit a job")
     sp.add_argument("--wait", action="store_true")

@@ -1,0 +1,62 @@
+"""Dashboard HTTP API + tracing spans."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_dashboard_serves_state(cluster):
+    from ray_tpu.dashboard import start_dashboard
+
+    url = start_dashboard(port=18266)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(3)])
+
+    with urllib.request.urlopen(f"{url}/") as r:
+        assert b"ray_tpu dashboard" in r.read()
+    with urllib.request.urlopen(f"{url}/api/cluster") as r:
+        cluster_info = json.loads(r.read())
+        assert cluster_info["total"]["CPU"] == 4.0
+    with urllib.request.urlopen(f"{url}/api/tasks") as r:
+        tasks = json.loads(r.read())
+        assert any(t["name"] == "f" for t in tasks)
+    with urllib.request.urlopen(f"{url}/api/nodes") as r:
+        assert len(json.loads(r.read())) >= 1
+
+
+def test_tracing_spans_parent_child(cluster, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACE", "1")
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def child_task(x):
+        time.sleep(0.02)
+        return x * 2
+
+    with tracing.span("driver_block"):
+        ref = child_task.remote(21)
+        assert ray_tpu.get(ref) == 42
+
+    spans = tracing.get_trace()
+    names = {s["name"] for s in spans}
+    assert "driver_block" in names and "child_task" in names
+    driver = next(s for s in spans if s["name"] == "driver_block")
+    child = next(s for s in spans if s["name"] == "child_task")
+    # Same trace; the task span is a child of the driver span.
+    assert child["trace_id"] == driver["trace_id"]
+    assert child["parent_span_id"] == driver["span_id"]
+    assert child["end"] - child["start"] >= 0.015

@@ -1,0 +1,114 @@
+"""Runtime environments + accelerator manager.
+
+Models the reference's python/ray/tests/test_runtime_env*.py and
+accelerator manager unit tests.
+"""
+import os
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_env_vars_task(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_RE_FLAG": "hello"}})
+    def read_env():
+        return os.environ.get("MY_RE_FLAG")
+
+    assert ray_tpu.get(read_env.remote()) == "hello"
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_RE_FLAG")
+
+    # Restored after the task: pooled workers don't leak the env.
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+def test_env_vars_actor_lifetime(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "on"}})
+    class EnvActor:
+        def read(self):
+            return os.environ.get("ACTOR_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.read.remote()) == "on"
+    assert ray_tpu.get(a.read.remote()) == "on"  # persists across calls
+
+
+def test_working_dir_ships_code(cluster, tmp_path):
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "helper_mod.py").write_text("def value():\n    return 'shipped'\n")
+    (pkg / "data.txt").write_text("file-content")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+    def use_pkg():
+        import helper_mod  # importable from the shipped dir
+
+        with open("data.txt") as f:  # cwd is the shipped dir
+            data = f.read()
+        return helper_mod.value(), data
+
+    assert ray_tpu.get(use_pkg.remote()) == ("shipped", "file-content")
+
+
+def test_py_modules(cluster, tmp_path):
+    mod = tmp_path / "extra_mod_dir"
+    mod.mkdir()
+    (mod / "extra_util.py").write_text("X = 41\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def use_module():
+        import extra_util
+
+        return extra_util.X + 1
+
+    assert ray_tpu.get(use_module.remote()) == 42
+
+
+def test_invalid_runtime_env_key(cluster):
+    with pytest.raises(ValueError, match="Unsupported runtime_env"):
+
+        @ray_tpu.remote(runtime_env={"pip": ["torch"]})
+        def f():
+            return 1
+
+        f.remote()
+
+
+# ------------------------------------------------------------ accelerators
+def test_tpu_manager_detection_env_override(monkeypatch):
+    from ray_tpu._private.accelerators import TPUAcceleratorManager as M
+
+    monkeypatch.setenv("RAY_TPU_NUM_CHIPS", "4")
+    assert M.get_current_node_num_accelerators() == 4
+
+
+def test_tpu_manager_type_and_head_resources(monkeypatch):
+    from ray_tpu._private.accelerators import TPUAcceleratorManager as M
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_NAME", "mypod")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    assert M.get_current_node_accelerator_type() == "v5e"
+    extra = M.get_current_node_additional_resources()
+    assert extra == {"TPU-pod-mypod": 1.0, "TPU-v5e-head": 1.0}
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    assert "TPU-v5e-head" not in M.get_current_node_additional_resources()
+
+
+def test_tpu_visible_chips_bounds():
+    from ray_tpu._private.accelerators import TPUAcceleratorManager as M
+
+    env = {}
+    M.set_visible_accelerator_ids(env, ["0", "1"])
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,2,1"
