@@ -51,8 +51,17 @@ class CoreClient:
         if not reply.get("ok"):
             raise RayTpuError(f"failed to register with GCS: {reply}")
         self.session_dir = reply["session_dir"]
+        self._authkey = authkey
         self._registered_functions: set = set()
         self._fn_lock = threading.Lock()
+        # Direct actor-call path (reference: actor calls bypass raylets,
+        # gRPC straight to the actor process —
+        # transport/direct_actor_task_submitter.h). aid -> PeerConn, or
+        # None when the actor must stay on the GCS route (restartable).
+        self._direct_lock = threading.Lock()
+        self._direct_conns: Dict[bytes, Optional[Any]] = {}
+        self._direct_results: Dict[bytes, Any] = {}  # oid -> Future(fields)
+        self._direct_oids: Dict[bytes, set] = {}  # aid -> unresolved oids
 
     def _on_push(self, msg: Dict[str, Any]):
         self._push_handler(msg)
@@ -77,6 +86,99 @@ class CoreClient:
         self.conn.send({"type": "submit_task", "spec": spec})
         owner = self.worker_id.binary()
         return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+
+    # ----------------------------------------------------- direct actor path
+    def _direct_conn_for(self, aid: bytes):
+        with self._direct_lock:
+            if aid in self._direct_conns:
+                return self._direct_conns[aid]
+        # First call: ask the GCS (parks until the actor is ALIVE, then
+        # returns its socket — or fallback for restartable/dead actors).
+        reply = self.request({"type": "get_actor_direct", "actor_id": aid})
+        conn = None
+        if reply.get("ok") and not reply.get("fallback") and reply.get("addr"):
+            from multiprocessing.connection import Client as MpClient
+
+            try:
+                raw = MpClient(
+                    reply["addr"], family="AF_UNIX", authkey=self._authkey
+                )
+                conn = PeerConn(
+                    raw,
+                    push_handler=lambda msg: None,
+                    on_close=lambda a=aid: self._on_direct_close(a),
+                    name="direct",
+                )
+            except OSError:
+                conn = None
+        with self._direct_lock:
+            self._direct_conns[aid] = conn
+        return conn
+
+    def submit_actor_direct(self, spec: TaskSpec) -> Optional[List[ObjectRef]]:
+        """Send an actor method straight to its worker; returns None to
+        fall back to GCS routing (restartable or dead actors)."""
+        from concurrent.futures import Future
+
+        aid = spec.actor_id.binary()
+        conn = self._direct_conn_for(aid)
+        if conn is None:
+            return None
+        oids = [oid.binary() for oid in spec.return_object_ids()]
+        futs = []
+        with self._direct_lock:
+            pending = self._direct_oids.setdefault(aid, set())
+            for ob in oids:
+                f: Future = Future()
+                self._direct_results[ob] = f
+                pending.add(ob)
+                futs.append(f)
+        try:
+            rfut = conn.request_async({"type": "execute_task", "spec": spec})
+        except BaseException:
+            self._on_direct_close(aid)
+            return None
+        rfut.add_done_callback(
+            lambda f, oids=oids, aid=aid: self._resolve_direct(aid, oids, f)
+        )
+        owner = self.worker_id.binary()
+        return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+
+    def _resolve_direct(self, aid: bytes, oids, rfut) -> None:
+        from ..exceptions import ActorDiedError
+
+        try:
+            reply = rfut.result()
+        except BaseException:
+            reply = None
+        with self._direct_lock:
+            pending = self._direct_oids.get(aid, set())
+            futs = [
+                (ob, self._direct_results.get(ob)) for ob in oids
+            ]
+            pending.difference_update(oids)
+        for i, (ob, f) in enumerate(futs):
+            if f is None or f.done():
+                continue
+            if reply is None:
+                f.set_exception(ActorDiedError(reason="connection lost"))
+            elif reply.get("error") is not None:
+                f.set_result({"status": "FAILED", "error": reply["error"]})
+            else:
+                fields = dict(reply["results"][i])
+                fields["status"] = "READY"
+                f.set_result(fields)
+
+    def _on_direct_close(self, aid: bytes) -> None:
+        from ..exceptions import ActorDiedError
+
+        with self._direct_lock:
+            self._direct_conns[aid] = None
+            pending = self._direct_oids.pop(aid, set())
+            futs = [self._direct_results.get(ob) for ob in pending]
+        for f in futs:
+            if f is not None and not f.done():
+                f.set_exception(ActorDiedError(reason="actor connection lost"))
 
     # ------------------------------------------------------------------ objects
 
@@ -123,6 +225,16 @@ class CoreClient:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise GetTimeoutError(f"get timed out on {ref}")
+            # Direct actor-call results resolve on the direct socket —
+            # no GCS round-trip on the critical path.
+            fut = self._direct_results.get(ref.id().binary())
+            if fut is not None:
+                try:
+                    reply = fut.result(timeout=remaining)
+                except TimeoutError:
+                    raise GetTimeoutError(f"get timed out on {ref}") from None
+                out.append(self._materialize(reply, ref.id()))
+                continue
             try:
                 reply = self.conn.request(
                     {"type": "get_object", "object_id": ref.id().binary()},
@@ -161,6 +273,9 @@ class CoreClient:
                 pass
 
     def free(self, refs: Sequence[ObjectRef]):
+        with self._direct_lock:
+            for r in refs:
+                self._direct_results.pop(r.id().binary(), None)
         self.conn.send(
             {"type": "free_objects", "object_ids": [r.id().binary() for r in refs]}
         )

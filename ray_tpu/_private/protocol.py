@@ -74,6 +74,22 @@ class PeerConn:
             with self._pending_lock:
                 self._pending.pop(req_id, None)
 
+    def request_async(self, msg: Dict[str, Any]) -> Future:
+        """Fire a request, return the reply Future (for pipelined
+        direct actor calls — many in flight on one connection)."""
+        req_id = next(self._req_counter)
+        msg = dict(msg, req_id=req_id)
+        fut: Future = Future()
+        with self._pending_lock:
+            self._pending[req_id] = fut
+        try:
+            self.send(msg)
+        except BaseException:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise
+        return fut
+
     def reply(self, req_msg: Dict[str, Any], **fields) -> None:
         self.send({"type": "reply", "req_id": req_msg["req_id"], **fields})
 
