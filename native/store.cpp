@@ -43,6 +43,9 @@ constexpr uint32_t kStateEmpty = 0;
 constexpr uint32_t kStateCreated = 1;
 constexpr uint32_t kStateSealed = 2;
 constexpr uint32_t kStateTombstone = 3;
+// Deleted while referenced: invisible to get/contains, freed by the
+// last store_release (independent of eviction, which may be disabled).
+constexpr uint32_t kStateDeleting = 4;
 
 struct Entry {
   uint8_t id[16];
@@ -356,6 +359,13 @@ int32_t store_seal(uint64_t handle, const uint8_t* id) {
   Store* s = reinterpret_cast<Store*>(handle);
   lock(s);
   Entry* e = find_entry(s, id, false);
+  if (e && e->state == kStateDeleting) {
+    // Deleted mid-write: drop the creator ref; last ref frees the block.
+    if (e->refcount > 0) e->refcount--;
+    if (e->refcount == 0) free_entry(s, e);
+    unlock(s);
+    return -1;
+  }
   if (!e || e->state != kStateCreated) {
     unlock(s);
     return -1;
@@ -402,6 +412,7 @@ int32_t store_release(uint64_t handle, const uint8_t* id) {
     return -1;
   }
   if (e->refcount > 0) e->refcount--;
+  if (e->refcount == 0 && e->state == kStateDeleting) free_entry(s, e);
   unlock(s);
   return 0;
 }
@@ -415,7 +426,9 @@ int32_t store_delete(uint64_t handle, const uint8_t* id) {
     return -1;
   }
   if (e->refcount > 0) {
-    // Deferred: evictable the moment the refcount drops (mark LRU-old).
+    // Deferred: the last store_release frees the block (works even with
+    // eviction disabled — the session pool's default).
+    e->state = kStateDeleting;
     e->lru = 0;
     unlock(s);
     return 1;

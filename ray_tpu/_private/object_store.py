@@ -34,7 +34,12 @@ def _untrack(shm: shared_memory.SharedMemory) -> None:
 
 
 def segment_name(object_id: ObjectID) -> str:
-    return "rtpu_" + object_id.hex()
+    # Namespaced per node so two node daemons colocated on one machine
+    # (tests, multi-daemon hosts) don't see each other's segments through
+    # the shared /dev/shm namespace — cross-node reads must go through
+    # the object transfer plane, as on a real multi-host cluster.
+    ns = os.environ.get("RAY_TPU_NODE_NS", "")
+    return f"rtpu_{ns}{object_id.hex()}"
 
 
 class ObjectStore:
@@ -53,6 +58,7 @@ class ObjectStore:
         self._lock = threading.Lock()
         self._pool = None
         self._pool_refs: Dict[bytes, int] = {}  # oid -> get() refcount held
+        self._raw_creates: set = set()  # oids mid-transfer in the pool
         pool_name = os.environ.get("RAY_TPU_POOL_NAME")
         if pool_name:
             try:
@@ -122,6 +128,105 @@ class ObjectStore:
         except FileNotFoundError:
             return False
 
+    # ------------------------------------------------------ raw byte access
+    # The transfer plane (object_transfer.py) moves objects between nodes
+    # as raw serialized bytes; these methods expose the stored
+    # representation without deserializing.
+
+    def get_raw(self, object_id: ObjectID) -> Optional[memoryview]:
+        """A view of the exact serialized bytes, or None if absent.
+        Pin released with release_raw()."""
+        if self._pool is not None:
+            view = self._pool.get(object_id.binary())
+            if view is not None:
+                with self._lock:
+                    self._pool_refs[object_id.binary()] = (
+                        self._pool_refs.get(object_id.binary(), 0) + 1
+                    )
+                return view
+        name = segment_name(object_id)
+        with self._lock:
+            shm = self._segments.get(name)
+        if shm is None:
+            try:
+                shm = shared_memory.SharedMemory(name=name)
+            except FileNotFoundError:
+                return None
+            _untrack(shm)
+            with self._lock:
+                self._segments[name] = shm
+        try:
+            return shm.buf[: serialization.total_size(shm.buf)]
+        except ValueError:
+            return None  # unsealed/corrupt
+
+    def release_raw(self, object_id: ObjectID) -> None:
+        if self._pool is not None:
+            with self._lock:
+                n = self._pool_refs.get(object_id.binary(), 0)
+                if n > 0:
+                    self._pool_refs[object_id.binary()] = n - 1
+                    if n == 1:
+                        del self._pool_refs[object_id.binary()]
+            if n > 0:
+                self._pool.release(object_id.binary())
+
+    def create_raw(self, object_id: ObjectID, size: int) -> Optional[memoryview]:
+        """Writable view for an incoming transfer; seal_raw() when full.
+        Returns None if the object already exists locally."""
+        if self._pool is not None:
+            view = self._pool.create(object_id.binary(), max(size, 1))
+            if view is not None:
+                with self._lock:
+                    self._raw_creates.add(object_id.binary())
+                return view
+            if self._pool.contains(object_id.binary()):
+                return None
+        name = segment_name(object_id)
+        with self._lock:
+            if name in self._segments:
+                return None
+        try:
+            shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        except FileExistsError:
+            return None
+        _untrack(shm)
+        with self._lock:
+            self._segments[name] = shm
+        return shm.buf[:size]
+
+    def seal_raw(self, object_id: ObjectID) -> None:
+        if self._pool is not None:
+            with self._lock:
+                was_pool = object_id.binary() in self._raw_creates
+                self._raw_creates.discard(object_id.binary())
+            if was_pool:
+                self._pool.seal(object_id.binary())
+        # Segment path: visible by name once created; nothing to do.
+
+    def abort_raw(self, object_id: ObjectID) -> None:
+        """Drop a partially-transferred object."""
+        if self._pool is not None:
+            with self._lock:
+                was_pool = object_id.binary() in self._raw_creates
+                self._raw_creates.discard(object_id.binary())
+            if was_pool:
+                # Seal then delete: delete only works on table entries and
+                # the creator's ref is dropped by seal.
+                self._pool.seal(object_id.binary())
+                self._pool.delete(object_id.binary())
+                return
+        name = segment_name(object_id)
+        with self._lock:
+            shm = self._segments.pop(name, None)
+        if shm is not None:
+            try:
+                resource_tracker.register(shm._name, "shared_memory")  # noqa: SLF001
+                shm.unlink()
+                shm.close()
+            except Exception:  # noqa: BLE001
+                pass
+
     def release(self, object_id: ObjectID) -> None:
         """Drop this process's mapping/refcount (does not delete)."""
         if self._pool is not None:
@@ -143,12 +248,13 @@ class ObjectStore:
                     self._segments[segment_name(object_id)] = shm
 
     def delete(self, object_id: ObjectID) -> None:
-        """Unlink the object from the node (owner/GCS-driven)."""
+        """Unlink the object from the node (owner/GCS-driven).
+
+        Refcounts this process holds (zero-copy views returned by get())
+        are NOT dropped here: the C++ store defers the free until the
+        last release, so live views stay valid until release()/close().
+        """
         if self._pool is not None:
-            with self._lock:
-                n = self._pool_refs.pop(object_id.binary(), 0)
-            for _ in range(n):
-                self._pool.release(object_id.binary())
             self._pool.delete(object_id.binary())
         name = segment_name(object_id)
         with self._lock:

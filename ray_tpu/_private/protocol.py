@@ -107,12 +107,13 @@ class PeerConn:
         except (EOFError, OSError, BrokenPipeError):
             pass
         except TypeError:
-            # Interpreter teardown: multiprocessing's read() gets a None
-            # handle when the connection closes mid-recv at exit. A
-            # TypeError during normal operation is a real bug — re-raise.
+            # multiprocessing's read() gets a None handle when close()
+            # races recv — at interpreter exit or on mid-session
+            # connection close. Both are connection loss; a TypeError
+            # with the handle still live is a real bug — re-raise.
             import sys
 
-            if not sys.is_finalizing():
+            if not (sys.is_finalizing() or self._conn.closed):
                 raise
         finally:
             self._closed.set()

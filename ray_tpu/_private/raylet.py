@@ -1,0 +1,269 @@
+"""Per-node daemon: worker pool + local object store on a cluster node.
+
+Reference: src/ray/raylet/ — the raylet is the per-node daemon that owns
+the local worker pool (worker_pool.h:159), embeds the plasma store, and
+serves object transfer (the ObjectManager lives inside it,
+object_manager.h:117). Scheduling decisions stay central in this
+rebuild (the GCS owns the cluster resource view and dispatches
+directly), so the daemon's job is mechanics, not policy:
+
+  - register the node (resources + transfer address) with the head GCS
+    over TCP and heartbeat it
+  - spawn/kill worker processes when the GCS asks; workers connect
+    straight back to the GCS control plane themselves
+  - own the node-local shm pool and serve chunked object pulls from it
+    (the data plane — object_transfer.py)
+
+Started by `ray_tpu start --address=<head_host:port>` (scripts/cli.py)
+or programmatically via cluster_utils for tests.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from .config import RayConfig
+from .ids import WorkerID
+from .object_store import ObjectStore
+from .object_transfer import ObjectTransferServer
+from .protocol import ConnectionLost, PeerConn
+from . import transport
+
+
+class NodeDaemon:
+    def __init__(
+        self,
+        gcs_address: str,
+        authkey: bytes,
+        resources: Dict[str, float],
+        label: str = "",
+        transfer_host: str = "127.0.0.1",
+    ):
+        self.gcs_address = gcs_address
+        self.authkey = authkey
+        self.resources = resources
+        self.label = label
+        self._workers: Dict[bytes, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+
+        # Node-local object pool: our own namespace + pool, inherited by
+        # the workers we spawn. Set BEFORE the store/transfer server are
+        # created so they attach to this node's pool.
+        self.node_ns = secrets.token_hex(4) + "_"
+        os.environ["RAY_TPU_NODE_NS"] = self.node_ns
+        pool_name = f"/rtpu_pool_{secrets.token_hex(4)}"
+        self._pool = None
+        try:
+            from .native_store import PoolStore, native_available
+
+            if native_available():
+                self._pool = PoolStore(pool_name, create=True)
+                os.environ["RAY_TPU_POOL_NAME"] = pool_name
+            else:
+                os.environ.pop("RAY_TPU_POOL_NAME", None)
+        except Exception:  # noqa: BLE001 - per-object segment fallback
+            self._pool = None
+            os.environ.pop("RAY_TPU_POOL_NAME", None)
+        self.store = ObjectStore()
+        self.transfer = ObjectTransferServer(
+            self.store, f"{transfer_host}:0", authkey
+        )
+
+        raw = transport.connect(gcs_address, authkey)
+        self.conn = PeerConn(
+            raw,
+            push_handler=self._on_push,
+            on_close=self._on_gcs_close,
+            name="raylet",
+        )
+        reply = self.conn.request(
+            {
+                "type": "register_node",
+                "resources": resources,
+                "transfer_addr": self.transfer.address,
+                "label": label or os.uname().nodename,
+                "pid": os.getpid(),
+            },
+            timeout=RayConfig.worker_register_timeout_s,
+        )
+        if not reply.get("ok"):
+            raise RuntimeError(f"node registration failed: {reply}")
+        self.node_id: bytes = reply["node_id"]
+        self.session_dir: str = reply["session_dir"]
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="raylet-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+
+    # --------------------------------------------------------------- pushes
+
+    def _on_push(self, msg):
+        mtype = msg.get("type")
+        if mtype == "spawn_worker":
+            self._spawn_worker(msg)
+        elif mtype == "kill_worker":
+            self._kill_worker(msg["worker_id"])
+        elif mtype == "free_objects":
+            for oid in msg.get("object_ids", []):
+                from .ids import ObjectID
+
+                try:
+                    self.store.delete(ObjectID(oid))
+                except Exception:  # noqa: BLE001
+                    pass
+        elif mtype == "shutdown":
+            self.shutdown()
+
+    def _spawn_worker(self, msg):
+        wid = WorkerID(msg["worker_id"])
+        env = dict(os.environ)
+        env["RAY_TPU_SESSION_ADDR"] = self.gcs_address
+        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
+        env["RAY_TPU_WORKER_ID"] = wid.hex()
+        env["RAY_TPU_NODE_NS"] = self.node_ns
+        if not msg.get("tpu"):
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("PYTHONPATH", "")
+        env["PYTHONPATH"] = (
+            os.getcwd() + os.pathsep + sys.path[0] + os.pathsep + env["PYTHONPATH"]
+        )
+        logdir = os.path.join(self.session_dir, "logs")
+        try:
+            os.makedirs(logdir, exist_ok=True)
+            out = open(os.path.join(logdir, f"worker-{wid.hex()[:8]}.out"), "ab")
+        except OSError:
+            # Remote machine: session dir may not exist here; use local tmp.
+            logdir = os.path.join("/tmp", "ray_tpu_logs")
+            os.makedirs(logdir, exist_ok=True)
+            out = open(os.path.join(logdir, f"worker-{wid.hex()[:8]}.out"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+        )
+        out.close()
+        with self._lock:
+            self._workers[wid.binary()] = proc
+
+    def _kill_worker(self, wid: bytes):
+        with self._lock:
+            proc = self._workers.pop(wid, None)
+        if proc is not None:
+            proc.terminate()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _heartbeat_loop(self):
+        interval = RayConfig.health_check_period_s
+        while not self._shutdown.wait(interval):
+            try:
+                self.conn.send(
+                    {"type": "node_heartbeat", "node_id": self.node_id}
+                )
+            except ConnectionLost:
+                return
+
+    def _on_gcs_close(self):
+        # Head died or network partition: this node is orphaned; take the
+        # workers down with us (reference: raylet exits when GCS
+        # connection is lost and no NotifyGCSRestart arrives).
+        if not self._shutdown.is_set():
+            self.shutdown()
+            os._exit(0)
+
+    def shutdown(self):
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        with self._lock:
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for proc in workers:
+            proc.terminate()
+        deadline = time.time() + 2.0
+        for proc in workers:
+            try:
+                proc.wait(timeout=max(0.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.transfer.shutdown()
+        try:
+            self.conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self.store.close()
+        if self._pool is not None:
+            try:
+                self._pool.destroy()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def wait(self):
+        """Block until shutdown (signal or GCS loss)."""
+        while not self._shutdown.wait(0.5):
+            pass
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="ray_tpu node daemon")
+    parser.add_argument("--address", required=True, help="head GCS host:port")
+    parser.add_argument("--authkey", default=None, help="cluster auth key (hex)")
+    parser.add_argument("--resources", default="{}", help="JSON resource dict")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--label", default="")
+    parser.add_argument(
+        "--transfer-host",
+        default=None,
+        help="host for the object transfer listener (default: node IP)",
+    )
+    args = parser.parse_args(argv)
+
+    authkey = bytes.fromhex(
+        args.authkey or os.environ.get("RAY_TPU_AUTHKEY", "")
+    )
+    resources = json.loads(args.resources)
+    if "CPU" not in resources:
+        from .node import default_resources
+
+        resources = {
+            **default_resources(
+                num_cpus=args.num_cpus,
+                num_tpus=args.num_tpus,
+            ),
+            **resources,
+        }
+    daemon = NodeDaemon(
+        args.address,
+        authkey,
+        resources,
+        label=args.label,
+        transfer_host=args.transfer_host or transport.node_ip(),
+    )
+
+    def on_signal(signum, frame):
+        daemon.shutdown()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    sys.stderr.write(
+        f"ray_tpu node daemon up: node_id={daemon.node_id.hex()[:8]} "
+        f"transfer={daemon.transfer.address}\n"
+    )
+    daemon.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -100,6 +100,20 @@ def unpack(view: memoryview | bytes) -> Any:
     return pickle.loads(payload, buffers=buffers)
 
 
+def total_size(view: memoryview | bytes) -> int:
+    """Exact serialized size of a value from its header (segments are
+    page-rounded, so the mapping may be larger than the object)."""
+    view = memoryview(view)
+    magic, pickle_len, nbuf = struct.unpack_from("<8sII", view, 0)
+    if magic != MAGIC:
+        raise ValueError("corrupt serialized object (bad magic)")
+    buf_lens = struct.unpack_from(f"<{nbuf}Q", view, 16)
+    size = _align(16 + 8 * nbuf + pickle_len)
+    for n in buf_lens:
+        size += _align(n)
+    return size
+
+
 def prepare_value(value: Any) -> Any:
     """Convert device arrays to host numpy before serialization.
 

@@ -1,0 +1,69 @@
+"""Address parsing + listener/connection construction for the control
+and data planes.
+
+Reference: src/ray/rpc/ — the reference talks gRPC over TCP between all
+daemons and unix sockets between a worker and its local raylet. Here
+both planes ride multiprocessing.connection (length-prefixed pickle
+frames with HMAC challenge auth): AF_UNIX for on-host peers (the fast
+path) and AF_INET for cross-host peers. An address is either a
+filesystem path (AF_UNIX) or "host:port" (AF_INET).
+"""
+from __future__ import annotations
+
+import socket
+from multiprocessing.connection import Client as MpClient
+from multiprocessing.connection import Connection, Listener
+from typing import Tuple, Union
+
+Address = Union[str, Tuple[str, int]]
+
+
+def is_tcp_address(address: str) -> bool:
+    """"host:port" (exactly one colon, numeric port) vs a unix path."""
+    if address.startswith("/") or address.startswith("."):
+        return False
+    host, sep, port = address.rpartition(":")
+    return bool(sep) and bool(host) and port.isdigit()
+
+
+def parse_address(address: str) -> Tuple[str, Address]:
+    """Returns (family, mp_address) for multiprocessing.connection."""
+    if is_tcp_address(address):
+        host, _, port = address.rpartition(":")
+        return "AF_INET", (host, int(port))
+    return "AF_UNIX", address
+
+
+def format_address(mp_address: Address) -> str:
+    if isinstance(mp_address, tuple):
+        return f"{mp_address[0]}:{mp_address[1]}"
+    return mp_address
+
+
+def make_listener(address: str, authkey: bytes) -> Listener:
+    family, addr = parse_address(address)
+    return Listener(addr, family=family, authkey=authkey)
+
+
+def listener_address(listener: Listener) -> str:
+    """Concrete address after bind (resolves port 0 to the real port)."""
+    return format_address(listener.address)
+
+
+def connect(address: str, authkey: bytes) -> Connection:
+    family, addr = parse_address(address)
+    return MpClient(addr, family=family, authkey=authkey)
+
+
+def node_ip() -> str:
+    """This host's primary outbound IP (reference:
+    python/ray/_private/services.py get_node_ip_address)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # No packet is sent; this just selects the route.
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()

@@ -57,6 +57,13 @@ class _ReplicaSet:
                     continue
         self.replicas = new
         self.handles = handles
+        # Drop drained counters for removed replicas so the estimate map
+        # doesn't grow across redeployments. Replicas removed with
+        # requests still in flight keep their count until it drains to 0
+        # (deleting early would let the finally resurrect the key at -1).
+        for rid in list(self.inflight):
+            if rid not in new and self.inflight[rid] <= 0:
+                del self.inflight[rid]
         self.changed.set()
         self.changed = threading.Event()
 
@@ -101,6 +108,7 @@ class Router:
         self._replica_set = _ReplicaSet()
         self._scheduler = PowerOfTwoChoicesReplicaScheduler(self._replica_set)
         self._num_queued = 0
+        self._queued_lock = threading.Lock()
         self._handle_id = uuid.uuid4().hex[:8]
         self._loop = asyncio.new_event_loop()
         threading.Thread(target=self._run_loop, daemon=True).start()
@@ -129,17 +137,35 @@ class Router:
         (rejections retried transparently). Raises BackPressureError
         when max_queued_requests is exceeded (reference: router.py
         handle-side queue cap)."""
-        cap = self._max_queued()
-        if cap >= 0 and self._num_queued >= cap:
-            from ...exceptions import BackPressureError
+        # Count the request against the queue cap synchronously on the
+        # caller thread — incrementing inside the coroutine would let a
+        # burst of callers all pass the cap before the loop runs.
+        with self._queued_lock:
+            cap = self._max_queued()
+            if cap >= 0 and self._num_queued >= cap:
+                from ...exceptions import BackPressureError
 
-            raise BackPressureError(
-                f"{self._dep_id}: {self._num_queued} queued requests "
-                f"(max_queued_requests={cap})"
+                raise BackPressureError(
+                    f"{self._dep_id}: {self._num_queued} queued requests "
+                    f"(max_queued_requests={cap})"
+                )
+            self._num_queued += 1
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self._assign_and_run(meta, args, kwargs), self._loop
             )
-        return asyncio.run_coroutine_threadsafe(
-            self._assign_and_run(meta, args, kwargs), self._loop
-        )
+        except BaseException:
+            with self._queued_lock:
+                self._num_queued -= 1
+            raise
+        # Decrement on the future, not in the coroutine: a cancel before
+        # the task's first step would skip the coroutine's finally.
+        fut.add_done_callback(self._dec_queued)
+        return fut
+
+    def _dec_queued(self, _fut):
+        with self._queued_lock:
+            self._num_queued -= 1
 
     def _max_queued(self) -> int:
         for info in self._replica_set.replicas.values():
@@ -148,39 +174,35 @@ class Router:
 
     # ---------------------------------------------------------- internal
     async def _assign_and_run(self, meta: RequestMetadata, args, kwargs):
-        args, kwargs = await _resolve_composed_args(args, kwargs)
         rs = self._replica_set
-        self._num_queued += 1
-        try:
-            while True:
-                rid = self._scheduler.choose(meta)
-                if rid is None:
-                    await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
+        args, kwargs = await _resolve_composed_args(args, kwargs)
+        while True:
+            rid = self._scheduler.choose(meta)
+            if rid is None:
+                await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
+                continue
+            handle = rs.handles.get(rid)
+            if handle is None:
+                await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
+                continue
+            rs.inflight[rid] += 1
+            try:
+                ref = handle.handle_request.remote(meta, *args, **kwargs)
+                return await ref
+            except RejectedError:
+                # Hard cap hit; try another replica.
+                await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
+            except Exception as e:
+                # Dead replica: drop it and retry until the controller
+                # pushes a fresh set (reference: router retries on
+                # ActorDiedError).
+                if _is_actor_death(e):
+                    rs.replicas.pop(rid, None)
+                    rs.handles.pop(rid, None)
                     continue
-                handle = rs.handles.get(rid)
-                if handle is None:
-                    await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
-                    continue
-                rs.inflight[rid] += 1
-                try:
-                    ref = handle.handle_request.remote(meta, *args, **kwargs)
-                    return await ref
-                except RejectedError:
-                    # Hard cap hit; try another replica.
-                    await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
-                except Exception as e:
-                    # Dead replica: drop it and retry until the controller
-                    # pushes a fresh set (reference: router retries on
-                    # ActorDiedError).
-                    if _is_actor_death(e):
-                        rs.replicas.pop(rid, None)
-                        rs.handles.pop(rid, None)
-                        continue
-                    raise
-                finally:
-                    rs.inflight[rid] -= 1
-        finally:
-            self._num_queued -= 1
+                raise
+            finally:
+                rs.inflight[rid] -= 1
 
     def _push_metrics_loop(self):
         while True:

@@ -166,3 +166,31 @@ def test_public_api_via_pool():
         assert abs(ray_tpu.get(consume.remote(ref)) - arr.sum()) < 1e-6
     finally:
         ray_tpu.shutdown()
+
+
+def test_deferred_delete_frees_on_last_release():
+    # Delete-while-referenced must free the block when the last reader
+    # releases, even with eviction disabled (the session-pool default) —
+    # otherwise deleted-but-referenced objects leak arena space forever.
+    name = f"/rtpu_dd_{os.getpid()}"
+    pool = PoolStore(name, create=True, pool_bytes=4 << 20, max_objects=64,
+                     evict=False)
+    try:
+        v = pool.create(_oid(7), 1 << 20)
+        del v
+        assert pool.seal(_oid(7))
+        g = pool.get(_oid(7))  # rc = 1
+        base = pool.stats()["bytes_in_use"]
+        pool.delete(_oid(7))  # deferred: reader still holds a ref
+        assert pool.stats()["bytes_in_use"] == base  # still pinned
+        assert not pool.contains(_oid(7))  # but invisible to readers
+        assert pool.get(_oid(7)) is None
+        del g
+        pool.release(_oid(7))  # last release frees, no eviction needed
+        assert pool.stats()["bytes_in_use"] < base
+        # The slot is reusable immediately.
+        v2 = pool.create(_oid(7), 1 << 20)
+        assert v2 is not None
+        del v2
+    finally:
+        pool.destroy()
