@@ -1,13 +1,20 @@
-"""Headline benchmark: Llama train-step throughput / MFU on one TPU chip.
+"""Headline benchmark: train-step throughput / MFU on one TPU chip.
 
 Measures the end-to-end jitted training step (fwd + bwd + adamw update,
-remat on, bf16 compute) of the Llama-1B config at seq 2048 and reports
-tokens/sec/chip and model FLOPs utilization against the v5e peak.
+remat on, bf16 compute, donated buffers) of the Llama-1B config at
+batch 2 x seq 2048 and reports tokens/sec/chip and model FLOPs
+utilization against the v5e peak; also runs a Mixtral-style sparse-MoE
+config (top-2 of 8 experts) and reports its MFU over *active* FLOPs.
+
+Batch is 2 because the 1B model's bf16 params + adamw moments + grads
+leave room for exactly two 2048-token activations sets on a 16 GiB
+chip even with buffer donation and full remat (b4 fits but is slower;
+b1 under-utilizes the MXU).
 
 BASELINE.md north star: Llama finetune >=40% MFU. vs_baseline is
 MFU / 0.40 (>1.0 beats the target).
 
-Prints exactly one JSON line.
+Prints exactly one JSON line; the MoE numbers ride in "extra".
 """
 from __future__ import annotations
 
@@ -15,35 +22,23 @@ import json
 import os
 import sys
 import time
+from functools import partial
 
 
-def model_flops_per_token(cfg, seq_len: int) -> float:
+def flops_per_token(n_params: float, cfg, seq_len: int) -> float:
     """6N matmul flops/token + attention score flops
     (12 * L * T * hidden per token, fwd+bwd)."""
-    n = cfg.num_params()
-    return 6.0 * n + 12.0 * cfg.num_layers * seq_len * cfg.hidden_size
+    return 6.0 * n_params + 12.0 * cfg.num_layers * seq_len * cfg.hidden_size
 
 
-def main() -> int:
-    # Defaults sized to one v5e-lite chip (batch 4 OOMs with adamw state).
-    batch = int(os.environ.get("BENCH_BATCH", "1"))
-    seq = int(os.environ.get("BENCH_SEQ", "1024"))
-    model_name = os.environ.get("BENCH_MODEL", "llama-1b")
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
-    peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))  # v5e bf16
-
+def bench_model(model, cfg, n_params, batch, seq, steps, peak_flops):
     import jax
-    import jax.numpy as jnp
     import numpy as np
+    import jax.numpy as jnp
     import optax
 
-    from ray_tpu.models import CONFIGS
-    from ray_tpu.models.llama import LlamaForCausalLM, causal_lm_loss
+    from ray_tpu.models.llama import causal_lm_loss
 
-    from dataclasses import replace
-
-    cfg = replace(CONFIGS[model_name], param_dtype=jnp.bfloat16)
-    model = LlamaForCausalLM(cfg)
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     targets = jnp.roll(ids, -1, axis=1)
@@ -52,7 +47,9 @@ def main() -> int:
     tx = optax.adamw(3e-4, b1=0.9, b2=0.95, mu_dtype=jnp.bfloat16)
     opt_state = tx.init(params)
 
-    @jax.jit
+    # Donate params + opt_state: the step consumes the old buffers in
+    # place, halving peak HBM (old+new copies never coexist).
+    @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, ids, targets):
         def loss_fn(p):
             return causal_lm_loss(model.apply(p, ids), targets)
@@ -75,8 +72,54 @@ def main() -> int:
 
     tokens = batch * seq * steps
     tok_per_s = tokens / dt
-    flops_per_tok = model_flops_per_token(cfg, seq)
-    mfu = tok_per_s * flops_per_tok / peak_flops
+    mfu = tok_per_s * flops_per_token(n_params, cfg, seq) / peak_flops
+    return tok_per_s, mfu, final_loss
+
+
+def main() -> int:
+    batch = int(os.environ.get("BENCH_BATCH", "2"))
+    seq = int(os.environ.get("BENCH_SEQ", "2048"))
+    model_name = os.environ.get("BENCH_MODEL", "llama-1b")
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))  # v5e bf16
+    run_moe = os.environ.get("BENCH_MOE", "1") != "0"
+
+    import jax.numpy as jnp
+
+    from dataclasses import replace
+
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.models.llama import LlamaForCausalLM
+
+    cfg = replace(CONFIGS[model_name], param_dtype=jnp.bfloat16)
+    tok_per_s, mfu, final_loss = bench_model(
+        LlamaForCausalLM(cfg), cfg, cfg.num_params(), batch, seq, steps,
+        peak_flops,
+    )
+
+    extra = {}
+    if run_moe:
+        from ray_tpu.models.mixtral import CONFIGS as MOE_CONFIGS
+        from ray_tpu.models.mixtral import MixtralForCausalLM
+
+        moe_cfg = replace(MOE_CONFIGS["mixtral-small"], param_dtype=jnp.bfloat16)
+        # MFU over *active* FLOPs: a top-k sparse model only computes k of
+        # E experts per token.
+        moe_tok, moe_mfu, moe_loss = bench_model(
+            MixtralForCausalLM(moe_cfg),
+            moe_cfg,
+            moe_cfg.active_params_per_token(),
+            batch,
+            seq,
+            steps,
+            peak_flops,
+        )
+        extra = {
+            "moe_model": "mixtral-small (8 experts, top-2)",
+            "moe_tokens_per_s": round(moe_tok, 1),
+            "moe_mfu_active": round(moe_mfu, 3),
+            "moe_loss": round(moe_loss, 3),
+        }
 
     print(
         json.dumps(
@@ -86,6 +129,7 @@ def main() -> int:
                 "value": round(tok_per_s, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(mfu / 0.40, 4),
+                "extra": extra,
             }
         )
     )

@@ -30,27 +30,35 @@ class CoreClient:
         role: str,
         worker_id: Optional[WorkerID] = None,
         push_handler: Optional[Callable[[Dict[str, Any]], None]] = None,
+        transfer_addr: Optional[str] = None,
     ):
-        from multiprocessing.connection import Client as MpClient
+        from . import transport
+        from .object_transfer import ObjectFetcher
 
         self.worker_id = worker_id or WorkerID.from_random()
         self.role = role
         self.store = ObjectStore()
         self._push_handler = push_handler or (lambda msg: None)
-        conn = MpClient(address, family="AF_UNIX", authkey=authkey)
+        conn = transport.connect(address, authkey)
         self.conn = PeerConn(conn, push_handler=self._on_push, name=f"client-{role}")
+        hello = {
+            "type": "hello",
+            "role": role,
+            "worker_id": self.worker_id.binary(),
+            "pid": os.getpid(),
+        }
+        if transfer_addr:
+            hello["transfer_addr"] = transfer_addr
         reply = self.conn.request(
-            {
-                "type": "hello",
-                "role": role,
-                "worker_id": self.worker_id.binary(),
-                "pid": os.getpid(),
-            },
-            timeout=RayConfig.worker_register_timeout_s,
+            hello, timeout=RayConfig.worker_register_timeout_s
         )
         if not reply.get("ok"):
             raise RayTpuError(f"failed to register with GCS: {reply}")
         self.session_dir = reply["session_dir"]
+        # The node this process's objects live on; objects located on
+        # other nodes are pulled through the transfer plane.
+        self.node_id: Optional[bytes] = reply.get("node_id")
+        self._fetcher = ObjectFetcher(self.store, authkey)
         self._authkey = authkey
         self._registered_functions: set = set()
         self._fn_lock = threading.Lock()
@@ -214,6 +222,23 @@ class CoreClient:
             raise err
         if reply.get("inline") is not None:
             return serialization.unpack(reply["inline"])
+        # Cross-node: the object's primary copy lives on another node —
+        # pull it into the local store first (reference: raylet
+        # PullManager fetching via the object directory).
+        owner_node = reply.get("node_id")
+        if (
+            owner_node is not None
+            and owner_node != self.node_id
+            and not self.store.contains(oid)
+        ):
+            addr = reply.get("transfer_addr")
+            if not addr or not self._fetcher.pull(oid, addr):
+                from ..exceptions import ObjectLostError
+
+                raise ObjectLostError(
+                    f"object {oid.hex()} on node "
+                    f"{owner_node.hex()[:8]} could not be fetched"
+                )
         return self.store.get(oid)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
@@ -279,6 +304,13 @@ class CoreClient:
         self.conn.send(
             {"type": "free_objects", "object_ids": [r.id().binary() for r in refs]}
         )
+        # Drop our local copies (pulled replicas / remote-driver puts);
+        # the GCS fan-out only reaches node daemons, not this process.
+        for r in refs:
+            try:
+                self.store.delete(r.id())
+            except Exception:  # noqa: BLE001
+                pass
 
     # ---------------------------------------------------------------------- kv
 
@@ -313,6 +345,7 @@ class CoreClient:
 
     def close(self):
         self.conn.close()
+        self._fetcher.close()
         self.store.close()
 
 

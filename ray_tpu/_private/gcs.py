@@ -107,6 +107,16 @@ class NodeState:
     # Fungible (non-actor) worker ids on this node.
     pool: Set[bytes] = field(default_factory=set)
     label: str = ""
+    # Multi-host: the node daemon's control connection (None for the head
+    # node and for virtual nodes, whose workers the GCS spawns directly),
+    # and the address of its chunked object-transfer server
+    # (reference: raylet NodeManager + embedded ObjectManager).
+    conn: Optional[PeerConn] = None
+    transfer_addr: str = ""
+    last_heartbeat: float = 0.0
+    # Remote drivers register as zero-resource nodes (their store serves
+    # pulls) but never receive dispatched work.
+    schedulable: bool = True
 
 
 @dataclass
@@ -146,7 +156,9 @@ def _release(avail: Dict[str, float], demand: Dict[str, float]) -> None:
 
 class GcsServer:
     def __init__(self, session_dir: str, address: str, authkey: bytes,
-                 head_resources: Dict[str, float]):
+                 head_resources: Dict[str, float],
+                 tcp_port: Optional[int] = None,
+                 head_transfer_addr: str = ""):
         self.session_dir = session_dir
         self.address = address
         self.authkey = authkey
@@ -179,19 +191,44 @@ class GcsServer:
             total=dict(head_resources),
             available=dict(head_resources),
             label="head",
+            transfer_addr=head_transfer_addr,
         )
         self.head_node = head
         self.nodes[head.node_id.binary()] = head
 
         self._listener = Listener(address, family="AF_UNIX", authkey=authkey)
+        # Optional network control plane: remote node daemons, their
+        # workers and remote drivers connect here (reference: the GCS
+        # gRPC server, src/ray/rpc/grpc_server.h).
+        self.tcp_address: Optional[str] = None
+        self._tcp_listener = None
+        if tcp_port is not None:
+            from . import transport
+
+            self._tcp_listener = transport.make_listener(
+                f"0.0.0.0:{tcp_port}", authkey
+            )
+            port = self._tcp_listener.address[1]
+            self.tcp_address = f"{transport.node_ip()}:{port}"
+            self._tcp_accept_thread = threading.Thread(
+                target=self._accept_loop_on,
+                args=(self._tcp_listener,),
+                name="gcs-accept-tcp",
+                daemon=True,
+            )
+            self._tcp_accept_thread.start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="gcs-accept", daemon=True
         )
         self._sched_thread = threading.Thread(
             target=self._sched_loop, name="gcs-sched", daemon=True
         )
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="gcs-health", daemon=True
+        )
         self._accept_thread.start()
         self._sched_thread.start()
+        self._health_thread.start()
         # Prestart a few workers so the first task doesn't pay spawn latency
         # (reference: worker_pool.cc:1323 PrestartWorkers).
         with self._lock:
@@ -203,11 +240,16 @@ class GcsServer:
     # ------------------------------------------------------------------ accept
 
     def _accept_loop(self):
+        self._accept_loop_on(self._listener)
+
+    def _accept_loop_on(self, listener):
         while not self._shutdown:
             try:
-                conn = self._listener.accept()
+                conn = listener.accept()
             except (OSError, EOFError):
                 break
+            except Exception:  # noqa: BLE001 - failed auth handshake etc.
+                continue
             state: Dict[str, Any] = {}
             peer = PeerConn(
                 conn,
@@ -225,6 +267,9 @@ class GcsServer:
         wid = state.get("worker_id")
         if wid is not None:
             self._handle_worker_death(wid, "worker connection closed")
+        nid = state.get("node_id")
+        if nid is not None and state.get("role") in ("raylet", "driver"):
+            self._handle_node_death(nid, "node daemon connection closed")
 
     # ---------------------------------------------------------------- dispatch
 
@@ -270,6 +315,7 @@ class GcsServer:
         peer: PeerConn = state["peer"]
         role = msg["role"]
         state["role"] = role
+        node_id = self.head_node.node_id.binary()
         if role == "worker":
             wid = msg["worker_id"]
             state["worker_id"] = wid
@@ -288,8 +334,30 @@ class GcsServer:
                 w.pid = msg.get("pid", 0)
                 w.state = W_IDLE
                 node.pool.add(wid)
+                node_id = node.node_id.binary()
                 self._work.notify_all()
-        peer.reply(msg, ok=True, session_dir=self.session_dir)
+        elif role == "driver" and msg.get("transfer_addr"):
+            # Remote driver: its objects live in its own store, served by
+            # its transfer server. Register a zero-resource node for it so
+            # the object directory can point pulls at it (reference: every
+            # driver's core worker owns the objects it puts).
+            with self._lock:
+                dnode = NodeState(
+                    node_id=NodeID.from_random(),
+                    total={},
+                    available={},
+                    label="driver",
+                    transfer_addr=msg["transfer_addr"],
+                    schedulable=False,
+                )
+                self.nodes[dnode.node_id.binary()] = dnode
+                node_id = dnode.node_id.binary()
+                state["node_id"] = node_id  # dies with this connection
+        # Where this peer's sealed objects live (put_object routing).
+        state["obj_node_id"] = node_id
+        peer.reply(
+            msg, ok=True, session_dir=self.session_dir, node_id=node_id
+        )
 
     def _h_register_function(self, state, msg):
         with self._lock:
@@ -473,19 +541,29 @@ class GcsServer:
             entry.inline = msg.get("inline")
             entry.segment = msg.get("segment")
             entry.size = msg.get("size", 0)
+            if entry.segment is not None:
+                nid = state.get("obj_node_id")
+                entry.node_id = NodeID(nid) if nid else self.head_node.node_id
             self._notify_object(entry)
         state["peer"].reply(msg, ok=True)
 
     def _object_reply_fields(self, entry: ObjectEntry) -> Dict[str, Any]:
         if entry.status == FAILED:
             return {"ok": True, "status": FAILED, "error": entry.error}
-        return {
+        fields = {
             "ok": True,
             "status": READY,
             "inline": entry.inline,
             "segment": entry.segment,
             "size": entry.size,
         }
+        if entry.segment is not None and entry.node_id is not None:
+            # Location for cross-node pulls (reference: the ownership-based
+            # object directory resolving a copy's node + transfer endpoint).
+            node = self.nodes.get(entry.node_id.binary())
+            fields["node_id"] = entry.node_id.binary()
+            fields["transfer_addr"] = node.transfer_addr if node else ""
+        return fields
 
     def _notify_object(self, entry: ObjectEntry):
         waiters, entry.waiters = entry.waiters, []
@@ -529,11 +607,22 @@ class GcsServer:
                 self.objects[oid].waiters.append((peer, msg["req_id"]))
 
     def _h_free_objects(self, state, msg):
+        daemons = []
         with self._lock:
             for oid in msg["object_ids"]:
                 entry = self.objects.pop(oid, None)
                 if entry is not None and entry.segment:
                     self._store.delete(ObjectID(oid))
+            daemons = [
+                n.conn for n in self.nodes.values() if n.alive and n.conn is not None
+            ]
+        # Fan the free out to every node daemon: each drops its local copy
+        # (primary or pulled replica) from its pool.
+        for conn in daemons:
+            try:
+                conn.send({"type": "free_objects", "object_ids": msg["object_ids"]})
+            except ConnectionLost:
+                pass
         if "req_id" in msg:
             state["peer"].reply(msg, ok=True)
 
@@ -920,6 +1009,77 @@ class GcsServer:
 
     # ------------------------------------------------------------- node admin
 
+    def _h_register_node(self, state, msg):
+        """A node daemon (raylet.py) joined over the network control
+        plane (reference: GcsNodeManager::HandleRegisterNode)."""
+        peer: PeerConn = state["peer"]
+        with self._lock:
+            node = NodeState(
+                node_id=NodeID.from_random(),
+                total=dict(msg["resources"]),
+                available=dict(msg["resources"]),
+                label=msg.get("label", ""),
+                conn=peer,
+                transfer_addr=msg.get("transfer_addr", ""),
+                last_heartbeat=time.time(),
+            )
+            self.nodes[node.node_id.binary()] = node
+            state["role"] = "raylet"
+            state["node_id"] = node.node_id.binary()
+            self._work.notify_all()
+        peer.reply(
+            msg,
+            ok=True,
+            node_id=node.node_id.binary(),
+            session_dir=self.session_dir,
+        )
+
+    def _h_node_heartbeat(self, state, msg):
+        with self._lock:
+            node = self.nodes.get(msg["node_id"])
+            if node is not None:
+                node.last_heartbeat = time.time()
+
+    def _health_loop(self):
+        """Declare daemon nodes dead when their heartbeats stop, even if
+        the TCP connection stays established (partition, SIGSTOP, hang)
+        (reference: GcsHealthCheckManager, gcs_health_check_manager.h:39)."""
+        period = RayConfig.health_check_period_ms / 1000.0
+        threshold = RayConfig.health_check_failure_threshold
+        while not self._shutdown:
+            time.sleep(period)
+            now = time.time()
+            with self._lock:
+                stale = [
+                    n.node_id.binary()
+                    for n in self.nodes.values()
+                    if n.alive
+                    and n.conn is not None
+                    and n.last_heartbeat > 0
+                    and now - n.last_heartbeat > period * threshold
+                ]
+            for nid in stale:
+                self._handle_node_death(
+                    nid, "node heartbeat timed out (unreachable or hung)"
+                )
+
+    def _handle_node_death(self, nid: bytes, reason: str):
+        with self._lock:
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            node.conn = None
+            dead_workers = [
+                w
+                for w in self.workers.values()
+                if w.node_id.binary() == nid and w.state != W_DEAD
+            ]
+        for w in dead_workers:
+            self._handle_worker_death(w.worker_id.binary(), reason)
+        with self._lock:
+            self._work.notify_all()
+
     def _h_add_node(self, state, msg):
         with self._lock:
             node = NodeState(
@@ -1027,7 +1187,9 @@ class GcsServer:
                     return self.nodes.get(bundle.node_id.binary())
             return None
         candidates = [
-            n for n in self.nodes.values() if n.alive and _fits(n.available, res)
+            n
+            for n in self.nodes.values()
+            if n.alive and n.schedulable and _fits(n.available, res)
         ]
         if not candidates:
             return None
@@ -1150,6 +1312,18 @@ class GcsServer:
         wid = WorkerID.from_random()
         w = WorkerHandle(worker_id=wid, node_id=node.node_id, tpu=tpu)
         self.workers[wid.binary()] = w
+        if node.conn is not None:
+            # Remote node: its daemon spawns the worker; the worker
+            # connects back to us over TCP on its own.
+            try:
+                node.conn.send(
+                    {"type": "spawn_worker", "worker_id": wid.binary(), "tpu": tpu}
+                )
+            except ConnectionLost:
+                self._handle_node_death(
+                    node.node_id.binary(), "daemon send failed"
+                )
+            return w
         env = dict(os.environ)
         env["RAY_TPU_SESSION_ADDR"] = self.address
         env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
@@ -1242,11 +1416,17 @@ class GcsServer:
             self._work.notify_all()
             workers = list(self.workers.values())
             peers = list(self._peers)
+            daemons = [n.conn for n in self.nodes.values() if n.conn is not None]
             segs = [
                 ObjectID(oid)
                 for oid, e in self.objects.items()
                 if e.segment is not None
             ]
+        for conn in daemons:
+            try:
+                conn.send({"type": "shutdown"})
+            except ConnectionLost:
+                pass
         for w in workers:
             if w.conn is not None:
                 try:
@@ -1265,6 +1445,11 @@ class GcsServer:
             self._listener.close()
         except Exception:
             pass
+        if self._tcp_listener is not None:
+            try:
+                self._tcp_listener.close()
+            except Exception:  # noqa: BLE001
+                pass
         for p in peers:
             p.close()
         for oid in segs:

@@ -48,9 +48,16 @@ def default_resources(
 
 
 class Node:
-    """Head node: owns the session and the in-process GCS."""
+    """Head node: owns the session and the in-process GCS.
 
-    def __init__(self, resources: Dict[str, float], temp_dir: Optional[str] = None):
+    With ``tcp_port`` set (0 = pick a free port) the GCS also listens on
+    the network, remote node daemons (raylet.py) can join the cluster,
+    and the head runs an object-transfer server so remote nodes can pull
+    objects sealed on the head.
+    """
+
+    def __init__(self, resources: Dict[str, float], temp_dir: Optional[str] = None,
+                 tcp_port: Optional[int] = None):
         base = temp_dir or os.path.join(tempfile.gettempdir(), "ray_tpu")
         os.makedirs(base, exist_ok=True)
         self.session_dir = os.path.join(
@@ -72,15 +79,32 @@ class Node:
                 os.environ["RAY_TPU_POOL_NAME"] = pool_name
         except Exception:  # noqa: BLE001 - per-object segments fallback
             self._pool = None
+        self._transfer = None
+        head_transfer_addr = ""
+        if tcp_port is not None:
+            from . import transport
+            from .object_store import ObjectStore
+            from .object_transfer import ObjectTransferServer
+
+            self._transfer = ObjectTransferServer(
+                ObjectStore(), f"{transport.node_ip()}:0", self.authkey
+            )
+            head_transfer_addr = self._transfer.address
         self.gcs = GcsServer(
             session_dir=self.session_dir,
             address=self.address,
             authkey=self.authkey,
             head_resources=resources,
+            tcp_port=tcp_port,
+            head_transfer_addr=head_transfer_addr,
         )
+        self.tcp_address = self.gcs.tcp_address
 
     def shutdown(self, cleanup_session: bool = True):
         self.gcs.shutdown()
+        if self._transfer is not None:
+            self._transfer.shutdown()
+            self._transfer = None
         if self._pool is not None:
             try:
                 self._pool.destroy()

@@ -165,7 +165,7 @@ class NodeDaemon:
     # ------------------------------------------------------------ lifecycle
 
     def _heartbeat_loop(self):
-        interval = RayConfig.health_check_period_s
+        interval = RayConfig.health_check_period_ms / 1000.0
         while not self._shutdown.wait(interval):
             try:
                 self.conn.send(

@@ -25,6 +25,7 @@ class _GlobalState:
         self.client: Optional[CoreClient] = None
         self.node: Optional[Node] = None
         self.mode: Optional[str] = None
+        self.transfer = None  # remote driver's object transfer server
         self.lock = threading.RLock()
 
     @property
@@ -55,12 +56,16 @@ def init(
     _system_config: Optional[Dict[str, Any]] = None,
     ignore_reinit_error: bool = False,
     _temp_dir: Optional[str] = None,
+    tcp_port: Optional[int] = None,
 ):
     """Start a local cluster (head) or connect to an existing one.
 
-    ``address`` is the head's session socket path (from ``node.address``);
-    None starts a new local head in-process, as the reference does
-    (reference: _private/worker.py:1225 → Node head bring-up).
+    ``address`` is the head's session socket path (from ``node.address``)
+    or ``host:port?authkey`` for a network head; None starts a new local
+    head in-process, as the reference does (reference:
+    _private/worker.py:1225 → Node head bring-up). ``tcp_port`` (0 = any
+    free port) makes the new head listen on the network so node daemons
+    (`ray_tpu start --address=...`) can join.
     """
     with _global.lock:
         if _global.connected:
@@ -88,17 +93,41 @@ def init(
                     f"({session_file} missing or stale); run "
                     "`ray-tpu start --head`"
                 ) from None
+        transfer_addr = None
         if address is None:
             node = Node(
-                default_resources(num_cpus, num_tpus, resources), temp_dir=_temp_dir
+                default_resources(num_cpus, num_tpus, resources),
+                temp_dir=_temp_dir,
+                tcp_port=tcp_port,
             )
             _global.node = node
             address_, authkey = node.address, node.authkey
         else:
-            # address format: "<socket_path>?<authkey_hex>"
+            # address format: "<socket_path_or_host:port>?<authkey_hex>"
             address_, authkey_hex = address.rsplit("?", 1)
             authkey = bytes.fromhex(authkey_hex)
-        _global.client = CoreClient(address_, authkey, role=DRIVER_MODE)
+            from . import transport
+
+            if transport.is_tcp_address(address_):
+                # Remote driver: objects it puts live in its own local
+                # store; run a transfer server so cluster nodes can pull
+                # them (the GCS registers us as a zero-resource node).
+                import os as _os
+                import secrets as _secrets
+
+                _os.environ.setdefault(
+                    "RAY_TPU_NODE_NS", _secrets.token_hex(4) + "_"
+                )
+                from .object_store import ObjectStore
+                from .object_transfer import ObjectTransferServer
+
+                _global.transfer = ObjectTransferServer(
+                    ObjectStore(), f"{transport.node_ip()}:0", authkey
+                )
+                transfer_addr = _global.transfer.address
+        _global.client = CoreClient(
+            address_, authkey, role=DRIVER_MODE, transfer_addr=transfer_addr
+        )
         _global.mode = DRIVER_MODE
         atexit.register(_atexit_shutdown)
         return _global.client
@@ -127,9 +156,15 @@ def shutdown():
                 pass
         if _global.node is not None:
             _global.node.shutdown()
+        if _global.transfer is not None:
+            try:
+                _global.transfer.shutdown()
+            except Exception:
+                pass
         _global.client = None
         _global.node = None
         _global.mode = None
+        _global.transfer = None
 
 
 def get(

@@ -1,13 +1,22 @@
-"""Cluster-in-one-process test harness.
+"""Cluster test harnesses.
 
-Reference: python/ray/cluster_utils.py:135 — N logical nodes in one
-GCS, so multi-node scheduling/failover tests run in a single CI
-container. ``add_node`` registers a new logical node with its own
-resource pool; ``remove_node`` kills it (and every worker on it).
+Reference: python/ray/cluster_utils.py:135. Two levels of realism:
+
+- ``Cluster``: N *logical* nodes in one GCS (the reference's in-process
+  harness) — multi-node scheduling/failover tests in one process tree,
+  all sharing the head's object store.
+- ``DaemonCluster``: head GCS listening on TCP plus N real node-daemon
+  *processes* (ray_tpu._private.raylet), each with its own shm pool and
+  object-transfer server — the full multi-host control + data plane on
+  one machine, the way the reference's fake_multi_node provider runs
+  real raylets locally.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
 
 import ray_tpu
 from ._private.worker import global_client
@@ -58,3 +67,90 @@ class Cluster:
 
     def shutdown(self):
         ray_tpu.shutdown()
+
+
+class DaemonCluster:
+    """Head + real node-daemon subprocesses over the TCP control plane."""
+
+    def __init__(self, head_node_args: Optional[dict] = None):
+        args = dict(head_node_args or {"num_cpus": 1})
+        args.setdefault("tcp_port", 0)
+        ray_tpu.init(**args, ignore_reinit_error=True)
+        from ._private.worker import _global
+
+        if _global.node is None or not _global.node.tcp_address:
+            raise RuntimeError(
+                "DaemonCluster needs a fresh TCP-enabled head; an existing "
+                "session without tcp_port is already initialized — "
+                "shutdown() first"
+            )
+        self.head_address = _global.node.tcp_address
+        self.authkey = _global.node.authkey
+        self._daemons: List[subprocess.Popen] = []
+
+    def add_node(
+        self,
+        *,
+        num_cpus: float = 1,
+        num_tpus: float = 0,
+        resources: Optional[Dict[str, float]] = None,
+        label: str = "",
+        wait: bool = True,
+    ) -> subprocess.Popen:
+        import json
+
+        res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        before = len(ray_tpu.nodes())
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ray_tpu._private.raylet",
+                "--address",
+                self.head_address,
+                "--authkey",
+                self.authkey.hex(),
+                "--resources",
+                json.dumps(res),
+                "--label",
+                label,
+                "--transfer-host",
+                "127.0.0.1",
+            ],
+            stderr=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+        )
+        self._daemons.append(proc)
+        if wait:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if len(ray_tpu.nodes()) > before:
+                    return proc
+                if proc.poll() is not None:
+                    _, err = proc.communicate()
+                    raise RuntimeError(
+                        f"node daemon exited: {err.decode(errors='replace')}"
+                    )
+                time.sleep(0.05)
+            raise TimeoutError("node daemon did not register within 30s")
+        return proc
+
+    def kill_node(self, proc: subprocess.Popen, graceful: bool = False):
+        proc.terminate() if graceful else proc.kill()
+        proc.wait(timeout=10)
+        if proc in self._daemons:
+            self._daemons.remove(proc)
+
+    def shutdown(self):
+        ray_tpu.shutdown()
+        deadline = time.time() + 5
+        for proc in self._daemons:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        self._daemons.clear()

@@ -190,7 +190,19 @@ class LlamaForCausalLM(nn.Module):
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="embed_tokens",
         )
-        x = emb(input_ids)
+        if self.mesh is not None and self.mesh.size > 1:
+            # One-hot matmul lookup: with the table sharded
+            # (vocab=tensor, embed=fsdp) a gather forces SPMD into full
+            # rematerialization (replicate-then-repartition every step);
+            # a contraction over the vocab axis instead becomes partial
+            # products + psum over `tensor`, rides the MXU, and XLA fuses
+            # the one-hot so the [B,S,V] operand is never materialized.
+            one_hot = jax.nn.one_hot(input_ids, cfg.vocab_size, dtype=cfg.dtype)
+            x = jnp.einsum(
+                "bsv,ve->bse", one_hot, emb.embedding.astype(cfg.dtype)
+            )
+        else:
+            x = emb(input_ids)
         x = with_logical_constraint(x, ("batch", "seq", "embed"))
         layer_cls = DecoderLayer
         if cfg.remat:

@@ -32,9 +32,26 @@ def _connect():
 
 
 def cmd_start(args):
+    if args.address:
+        # Worker node: join an existing head over TCP as a node daemon
+        # (reference: `ray start --address=<head>` starting a raylet).
+        from ray_tpu._private import raylet
+
+        daemon_args = ["--address", args.address]
+        if args.authkey:
+            daemon_args += ["--authkey", args.authkey]
+        if args.num_cpus is not None:
+            daemon_args += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            daemon_args += ["--num-tpus", str(args.num_tpus)]
+        raylet.main(daemon_args)
+        return
+
     import ray_tpu
 
-    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    ray_tpu.init(
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus, tcp_port=args.port
+    )
     from ray_tpu._private.worker import _global
 
     node = _global.node
@@ -44,6 +61,7 @@ def cmd_start(args):
         json.dump(
             {
                 "address": node.address,
+                "tcp_address": node.tcp_address,
                 "authkey": node.authkey.hex(),
                 "pid": os.getpid(),
                 "session_dir": node.session_dir,
@@ -52,6 +70,12 @@ def cmd_start(args):
         )
     os.replace(tmp, SESSION_FILE)  # atomic: readers never see partial JSON
     print(f"ray_tpu head started: {node.address}")
+    if node.tcp_address:
+        print(f"network address: {node.tcp_address}")
+        print(
+            "join a node with: python -m ray_tpu start "
+            f"--address={node.tcp_address} --authkey={node.authkey.hex()}"
+        )
     print(f"session file: {SESSION_FILE}")
     print("connect with: ray_tpu.init(address='auto')")
     stop = [False]
@@ -205,9 +229,21 @@ def main(argv=None):
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sp = sub.add_parser("start", help="start a standalone head")
+    sp = sub.add_parser(
+        "start", help="start a head (--head) or join one (--address)"
+    )
     sp.add_argument("--head", action="store_true")
-    sp.add_argument("--num-cpus", type=int, default=os.cpu_count())
+    sp.add_argument(
+        "--address", default=None, help="head host:port to join as a node"
+    )
+    sp.add_argument("--authkey", default=None, help="cluster auth key (hex)")
+    sp.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        help="TCP port for the head's network control plane (0 = any)",
+    )
+    sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.set_defaults(fn=cmd_start)
 

@@ -22,6 +22,11 @@ class ScalingConfig:
     resources_per_worker: Dict[str, float] = field(default_factory=dict)
     mesh: Optional[MeshSpec] = None
     placement_strategy: str = "PACK"
+    #: Form one jax.distributed cluster across the worker group so every
+    #: host sees the global device set (multi-host SPMD). Rank 0 brokers
+    #: the coordinator address through the GCS KV (replaces the
+    #: reference's NCCLUniqueIDStore actor — util/collective/util.py:9).
+    use_jax_distributed: bool = False
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker)

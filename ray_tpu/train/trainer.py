@@ -38,8 +38,9 @@ class TrainWorker:
     RayTrainWorker — train/_internal/worker_group.py)."""
 
     def __init__(self, rank: int, world_size: int, experiment_name: str,
-                 storage_path: Optional[str], coordinator: Optional[str] = None,
-                 num_processes: Optional[int] = None):
+                 storage_path: Optional[str], use_jax_distributed: bool = False,
+                 num_processes: Optional[int] = None,
+                 rendezvous_token: str = ""):
         self.rank = rank
         self.world_size = world_size
         self.session = init_session(
@@ -53,9 +54,15 @@ class TrainWorker:
             )
         )
         self._thread: Optional[threading.Thread] = None
-        if coordinator is not None and world_size > 1:
-            # Multi-host: join the jax.distributed cluster so all hosts see
-            # the global device set (SURVEY.md §5 distributed backend).
+        if use_jax_distributed and world_size > 1:
+            # Multi-host: join the jax.distributed cluster so all hosts
+            # see the global device set. Rank 0 binds the coordinator and
+            # publishes its address through the GCS KV; other ranks poll
+            # for it (reference: coordinator rendezvous via the named
+            # NCCLUniqueIDStore actor, util/collective/util.py:9).
+            coordinator = self._rendezvous(
+                f"{experiment_name}/{rendezvous_token}"
+            )
             import jax
 
             jax.distributed.initialize(
@@ -63,6 +70,33 @@ class TrainWorker:
                 num_processes=num_processes or world_size,
                 process_id=rank,
             )
+
+    def _rendezvous(self, rendezvous_id: str) -> str:
+        """rendezvous_id is unique per fit attempt (the driver mints a
+        fresh token for every _fit_once) so a group restart can never
+        read the previous attempt's dead coordinator address."""
+        from .._private import transport
+        from .._private.worker import global_client
+
+        client = global_client()
+        key = f"train_coordinator/{rendezvous_id}".encode()
+        if self.rank == 0:
+            import socket
+
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            addr = f"{transport.node_ip()}:{port}"
+            client.kv_put(key, addr.encode())
+            return addr
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            val = client.kv_get(key)
+            if val:
+                return val.decode()
+            time.sleep(0.1)
+        raise TimeoutError("jax.distributed coordinator address never published")
 
     def run(self, train_loop: Callable, config: Dict[str, Any],
             latest_checkpoint: Optional[str] = None) -> bool:
@@ -165,6 +199,9 @@ class JaxTrainer:
         )
         workers = []
         try:
+            import secrets
+
+            rdv_token = secrets.token_hex(4)
             worker_cls = ray_tpu.remote(TrainWorker)
             for rank in range(n):
                 workers.append(
@@ -174,7 +211,10 @@ class JaxTrainer:
                             placement_group_bundle_index=rank,
                         ),
                         max_concurrency=2,
-                    ).remote(rank, n, name, storage)
+                    ).remote(
+                        rank, n, name, storage, sc.use_jax_distributed,
+                        None, rdv_token,
+                    )
                 )
             ray_tpu.get([w.ping.remote() for w in workers], timeout=120)
             cfg = self._config
