@@ -21,6 +21,10 @@ from .task_spec import TaskSpec
 from ..exceptions import GetTimeoutError, RayTaskError, RayTpuError
 from ..object_ref import ObjectRef
 
+_MISSING = object()  # direct-route state: never looked up
+_LEASE_PIPELINE_MAX = 16  # max in-flight tasks per leased worker
+_LEASE_IDLE_RETURN_S = 0.5  # idle leases are given back after this
+
 
 class CoreClient:
     def __init__(
@@ -31,6 +35,7 @@ class CoreClient:
         worker_id: Optional[WorkerID] = None,
         push_handler: Optional[Callable[[Dict[str, Any]], None]] = None,
         transfer_addr: Optional[str] = None,
+        direct_addr: Optional[str] = None,
     ):
         from . import transport
         from .object_transfer import ObjectFetcher
@@ -49,6 +54,8 @@ class CoreClient:
         }
         if transfer_addr:
             hello["transfer_addr"] = transfer_addr
+        if direct_addr:
+            hello["direct_addr"] = direct_addr
         reply = self.conn.request(
             hello, timeout=RayConfig.worker_register_timeout_s
         )
@@ -64,12 +71,21 @@ class CoreClient:
         self._fn_lock = threading.Lock()
         # Direct actor-call path (reference: actor calls bypass raylets,
         # gRPC straight to the actor process —
-        # transport/direct_actor_task_submitter.h). aid -> PeerConn, or
-        # None when the actor must stay on the GCS route (restartable).
-        self._direct_lock = threading.Lock()
-        self._direct_conns: Dict[bytes, Optional[Any]] = {}
+        # transport/direct_actor_task_submitter.h). aid -> PeerConn once
+        # established, "resolving" while the GCS lookup is in flight
+        # (calls buffer so one ordered stream flows down exactly one
+        # path), or None when the actor stays on the GCS route
+        # (restartable actors).
+        self._direct_lock = threading.RLock()
+        self._direct_conns: Dict[bytes, Any] = {}
+        self._direct_buffer: Dict[bytes, list] = {}  # aid -> specs awaiting route
         self._direct_results: Dict[bytes, Any] = {}  # oid -> Future(fields)
         self._direct_oids: Dict[bytes, set] = {}  # aid -> unresolved oids
+        # Leased-worker pools per scheduling class (direct task transport).
+        self._lease_lock = threading.Lock()
+        self._leases: Dict[Any, list] = {}
+        self._lease_grow_failed_at: Dict[Any, float] = {}
+        self._lease_reaper: Optional[threading.Thread] = None
 
     def _on_push(self, msg: Dict[str, Any]):
         self._push_handler(msg)
@@ -95,22 +111,277 @@ class CoreClient:
         owner = self.worker_id.binary()
         return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
 
+    # ------------------------------------------------- leased task transport
+    # Reference: CoreWorkerDirectTaskSubmitter (direct_task_transport.cc:24)
+    # — the caller leases idle workers from the control plane once per
+    # burst and pushes tasks to them directly, so the steady-state task
+    # path costs one hop (caller -> worker -> caller) instead of four
+    # through the GCS. Resource accounting happens at lease grant/return
+    # granularity; the worker's async task_done keeps the object
+    # directory coherent for wait/free/cross-process refs.
+
+    def _lease_eligible(self, spec: TaskSpec) -> bool:
+        return (
+            spec.actor_id is None
+            and not spec.actor_creation
+            and not spec.dependencies
+            and spec.placement_group_id is None
+            and spec.scheduling_strategy is None
+            and not spec.retry_exceptions
+            and spec.function_blob is None  # first call registers via GCS
+            and spec.resources.get("TPU", 0) == 0
+        )
+
+    def submit_task_leased(self, spec: TaskSpec) -> Optional[List[ObjectRef]]:
+        """Push a task to a leased worker; None -> route via the GCS."""
+        if not self._lease_eligible(spec):
+            return None
+        key = spec.scheduling_class()
+        now = time.monotonic()
+        with self._lease_lock:
+            pool = self._leases.setdefault(key, [])
+            lease = min(pool, key=lambda c: c["outstanding"], default=None)
+            expand = (
+                lease is not None
+                and lease["outstanding"] >= _LEASE_PIPELINE_MAX
+                # Back off after a failed grow: each attempt is a
+                # synchronous GCS round-trip, and a saturated pool would
+                # otherwise retry on every submit of a burst.
+                and now - self._lease_grow_failed_at.get(key, 0.0) > 0.1
+            )
+            if lease is not None and not expand:
+                # Claim under the lock so the idle reaper can't return
+                # the lease between selection and push.
+                lease["outstanding"] += 1
+        if lease is None and now - self._lease_grow_failed_at.get(key, 0.0) <= 0.1:
+            return None  # recent failed acquire (e.g. remote driver): GCS route
+        if lease is None or expand:
+            fresh = self._acquire_lease(key, spec.resources)
+            if fresh is not None:
+                lease = fresh
+            else:
+                with self._lease_lock:
+                    self._lease_grow_failed_at[key] = time.monotonic()
+                if lease is None:
+                    return None  # no lease at all: GCS route
+            # Pool can't grow: queue on the least-loaded lease anyway —
+            # workers drain serially either way, and mixing paths would
+            # strand the GCS-routed overflow behind held leases.
+            with self._lease_lock:
+                lease["outstanding"] += 1
+        return self._push_leased(lease, spec)
+
+    def _acquire_lease(self, key, resources) -> Optional[dict]:
+        try:
+            reply = self.conn.request(
+                {"type": "lease_worker", "resources": resources}
+            )
+        except ConnectionLost:
+            return None
+        if not reply.get("ok") or not reply.get("addr"):
+            return None
+        from . import transport
+
+        try:
+            raw = transport.connect(reply["addr"], self._authkey)
+        except OSError:
+            # Worker on another machine (or gone): give the lease back.
+            try:
+                self.conn.send(
+                    {"type": "return_lease", "worker_id": reply["worker_id"]}
+                )
+            except ConnectionLost:
+                pass
+            return None
+        lease = {
+            "worker_id": reply["worker_id"],
+            "key": key,
+            "outstanding": 0,
+            "returned": False,
+        }
+        lease["conn"] = PeerConn(
+            raw, push_handler=lambda m: None, name="lease",
+        )
+        with self._lease_lock:
+            self._leases.setdefault(key, []).append(lease)
+        return lease
+
+    def _push_leased(self, lease, spec: TaskSpec) -> List[ObjectRef]:
+        """Caller must have already claimed a slot (outstanding += 1)."""
+        from concurrent.futures import Future
+
+        oids = [oid.binary() for oid in spec.return_object_ids()]
+        with self._lease_lock:
+            for ob in oids:
+                self._direct_results[ob] = Future()
+        try:
+            rfut = lease["conn"].request_async(
+                {"type": "execute_task", "spec": spec}
+            )
+        except BaseException:
+            # Send failed: the task never reached the worker, so a GCS
+            # resubmit is always safe.
+            self._leased_conn_lost(lease, spec, oids, delivered=False)
+            return self._refs_for(spec)
+        rfut.add_done_callback(
+            lambda f, lease=lease, spec=spec, oids=oids: self._resolve_leased(
+                lease, spec, oids, f
+            )
+        )
+        return self._refs_for(spec)
+
+    def _resolve_leased(self, lease, spec: TaskSpec, oids, rfut):
+        try:
+            reply = rfut.result()
+        except BaseException:  # noqa: BLE001 - conn lost after delivery
+            self._leased_conn_lost(lease, spec, oids, delivered=True)
+            return
+        for i, ob in enumerate(oids):
+            f = self._direct_results.get(ob)
+            if f is None or f.done():
+                continue
+            if reply.get("error") is not None:
+                f.set_result({"status": "FAILED", "error": reply["error"]})
+            else:
+                fields = dict(reply["results"][i])
+                fields["status"] = "READY"
+                f.set_result(fields)
+        self._dec_lease(lease)
+
+    def _leased_conn_lost(self, lease, spec: TaskSpec, oids, delivered: bool):
+        give_back = False
+        with self._lease_lock:
+            pool = self._leases.get(lease["key"], [])
+            if lease in pool:
+                pool.remove(lease)
+            if not lease["returned"]:
+                lease["returned"] = True
+                give_back = True
+        if give_back:
+            # The worker may still be alive with only the lease conn
+            # broken: give the lease back so it isn't stranded W_LEASED
+            # (idempotent if the worker actually died).
+            try:
+                self.conn.send(
+                    {"type": "return_lease", "worker_id": lease["worker_id"]}
+                )
+            except ConnectionLost:
+                pass
+        if delivered and spec.max_retries <= 0:
+            # May have executed: at-most-once for non-retriable tasks
+            # (reference: only retriable tasks resubmit on worker crash —
+            # task_manager.h:468).
+            from ..exceptions import WorkerCrashedError
+
+            blob = serialization.pack(
+                WorkerCrashedError("leased worker connection lost mid-task")
+            )
+            for ob in oids:
+                f = self._direct_results.pop(ob, None)
+                if f is not None and not f.done():
+                    f.set_result({"status": "FAILED", "error": blob})
+            return
+        if delivered:
+            spec.max_retries -= 1
+        for ob in oids:
+            f = self._direct_results.pop(ob, None)
+            if f is not None and not f.done():
+                f.set_result({"via_gcs": True})
+        try:
+            self.conn.send({"type": "submit_task", "spec": spec})
+        except ConnectionLost:
+            pass
+
+    def _dec_lease(self, lease):
+        with self._lease_lock:
+            lease["outstanding"] -= 1
+            if lease["outstanding"] <= 0:
+                # Keep the lease warm: returning on drain would pay a
+                # lease round-trip per burst (reference: leased workers
+                # are reused across tasks of a scheduling class and
+                # returned after an idle timeout).
+                lease["idle_since"] = time.monotonic()
+                self._ensure_lease_reaper()
+
+    def _ensure_lease_reaper(self):
+        if self._lease_reaper is None:
+            self._lease_reaper = threading.Thread(
+                target=self._lease_reaper_loop, name="lease-reaper", daemon=True
+            )
+            self._lease_reaper.start()
+
+    def _lease_reaper_loop(self):
+        while not self.conn.closed:
+            time.sleep(0.1)
+            now = time.monotonic()
+            to_return = []
+            with self._lease_lock:
+                for key, pool in self._leases.items():
+                    for lease in list(pool):
+                        if (
+                            lease["outstanding"] <= 0
+                            and not lease["returned"]
+                            and now - lease.get("idle_since", now)
+                            > _LEASE_IDLE_RETURN_S
+                        ):
+                            lease["returned"] = True
+                            pool.remove(lease)
+                            to_return.append(lease)
+            for lease in to_return:
+                lease["conn"].close()
+                try:
+                    self.conn.send(
+                        {"type": "return_lease", "worker_id": lease["worker_id"]}
+                    )
+                except ConnectionLost:
+                    return
+
     # ----------------------------------------------------- direct actor path
-    def _direct_conn_for(self, aid: bytes):
+    def submit_actor_direct(self, spec: TaskSpec) -> Optional[List[ObjectRef]]:
+        """Submit an actor method over the direct transport.
+
+        Returns the refs when the call is (or will be) delivered
+        directly or is buffered pending route resolution; None tells the
+        caller to route via the GCS (restartable actors). The first call
+        for an actor kicks off an async get_actor_direct lookup (the GCS
+        parks it until the actor is ALIVE); calls buffer until the route
+        is known so a single ordered stream flows down exactly one path —
+        mixing paths could reorder a caller's calls."""
+        aid = spec.actor_id.binary()
         with self._direct_lock:
-            if aid in self._direct_conns:
-                return self._direct_conns[aid]
-        # First call: ask the GCS (parks until the actor is ALIVE, then
-        # returns its socket — or fallback for restartable/dead actors).
-        reply = self.request({"type": "get_actor_direct", "actor_id": aid})
+            st = self._direct_conns.get(aid, _MISSING)
+            if st is None:
+                return None  # definitive: GCS route
+            if st is _MISSING:
+                self._direct_conns[aid] = "resolving"
+                self._direct_buffer[aid] = [spec]
+                rfut = self.conn.request_async(
+                    {"type": "get_actor_direct", "actor_id": aid}
+                )
+                rfut.add_done_callback(
+                    lambda f, a=aid: self._on_direct_resolved(a, f)
+                )
+                return self._refs_for(spec)
+            if st == "resolving":
+                self._direct_buffer[aid].append(spec)
+                return self._refs_for(spec)
+            return self._send_direct(st, spec)
+
+    def _refs_for(self, spec: TaskSpec) -> List[ObjectRef]:
+        owner = self.worker_id.binary()
+        return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+
+    def _on_direct_resolved(self, aid: bytes, rfut):
+        try:
+            reply = rfut.result()
+        except BaseException:  # noqa: BLE001
+            reply = {"fallback": True}
         conn = None
         if reply.get("ok") and not reply.get("fallback") and reply.get("addr"):
-            from multiprocessing.connection import Client as MpClient
+            from . import transport
 
             try:
-                raw = MpClient(
-                    reply["addr"], family="AF_UNIX", authkey=self._authkey
-                )
+                raw = transport.connect(reply["addr"], self._authkey)
                 conn = PeerConn(
                     raw,
                     push_handler=lambda msg: None,
@@ -120,37 +391,39 @@ class CoreClient:
             except OSError:
                 conn = None
         with self._direct_lock:
+            # Flush the buffer down the chosen path, then publish it —
+            # all under the lock so late submitters can't jump the queue.
+            buffered = self._direct_buffer.pop(aid, [])
+            for spec in buffered:
+                if conn is not None:
+                    self._send_direct(conn, spec)
+                else:
+                    try:
+                        self.submit(spec)
+                    except ConnectionLost:
+                        pass
             self._direct_conns[aid] = conn
-        return conn
 
-    def submit_actor_direct(self, spec: TaskSpec) -> Optional[List[ObjectRef]]:
-        """Send an actor method straight to its worker; returns None to
-        fall back to GCS routing (restartable or dead actors)."""
+    def _send_direct(self, conn, spec: TaskSpec) -> Optional[List[ObjectRef]]:
         from concurrent.futures import Future
 
         aid = spec.actor_id.binary()
-        conn = self._direct_conn_for(aid)
-        if conn is None:
-            return None
         oids = [oid.binary() for oid in spec.return_object_ids()]
-        futs = []
         with self._direct_lock:
             pending = self._direct_oids.setdefault(aid, set())
             for ob in oids:
                 f: Future = Future()
                 self._direct_results[ob] = f
                 pending.add(ob)
-                futs.append(f)
         try:
             rfut = conn.request_async({"type": "execute_task", "spec": spec})
         except BaseException:
             self._on_direct_close(aid)
-            return None
+            return self._refs_for(spec)  # futures fail via _on_direct_close
         rfut.add_done_callback(
             lambda f, oids=oids, aid=aid: self._resolve_direct(aid, oids, f)
         )
-        owner = self.worker_id.binary()
-        return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+        return self._refs_for(spec)
 
     def _resolve_direct(self, aid: bytes, oids, rfut) -> None:
         from ..exceptions import ActorDiedError
@@ -243,30 +516,51 @@ class CoreClient:
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
-        out = []
+        # Pipeline: fire every get_object request up front, then collect —
+        # a batch of N costs one round-trip of latency, not N (reference:
+        # the core worker batches plasma fetches in Get, core_worker.cc).
+        futs = []
         for ref in refs:
+            fut = self._direct_results.get(ref.id().binary())
+            if fut is not None:
+                # Direct actor-call result: resolves on the direct socket,
+                # no GCS round-trip.
+                futs.append((ref, fut, True))
+            else:
+                futs.append(
+                    (
+                        ref,
+                        self.conn.request_async(
+                            {"type": "get_object", "object_id": ref.id().binary()}
+                        ),
+                        False,
+                    )
+                )
+        out = []
+        for ref, fut, direct in futs:
             remaining = None
             if deadline is not None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise GetTimeoutError(f"get timed out on {ref}")
-            # Direct actor-call results resolve on the direct socket —
-            # no GCS round-trip on the critical path.
-            fut = self._direct_results.get(ref.id().binary())
-            if fut is not None:
-                try:
-                    reply = fut.result(timeout=remaining)
-                except TimeoutError:
-                    raise GetTimeoutError(f"get timed out on {ref}") from None
-                out.append(self._materialize(reply, ref.id()))
-                continue
             try:
-                reply = self.conn.request(
-                    {"type": "get_object", "object_id": ref.id().binary()},
-                    timeout=remaining,
-                )
+                reply = fut.result(timeout=remaining)
             except TimeoutError:
                 raise GetTimeoutError(f"get timed out on {ref}") from None
+            if direct:
+                # Consumed: later gets resolve through the GCS directory
+                # (the worker's async task_done seals results there), so
+                # holding the Future would only leak the inline payload.
+                self._direct_results.pop(ref.id().binary(), None)
+            if direct and reply.get("inline") is None and reply.get("status") != "FAILED":
+                oid = ref.id()
+                if not self.store.contains(oid):
+                    # Large direct result on another node's store — or a
+                    # via-GCS sentinel: the reply has no location info.
+                    reply = self.conn.request(
+                        {"type": "get_object", "object_id": oid.binary()},
+                        timeout=remaining,
+                    )
             out.append(self._materialize(reply, ref.id()))
         return out
 
@@ -279,8 +573,20 @@ class CoreClient:
         ids = [r.id().binary() for r in refs]
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            # Direct actor-call results resolve on the direct socket; the
+            # GCS only learns of them via the worker's async task_done —
+            # count locally-done futures as ready immediately.
+            direct_ready = {
+                oid
+                for oid in ids
+                if (f := self._direct_results.get(oid)) is not None and f.done()
+            }
+            has_direct_pending = any(
+                oid in self._direct_results and oid not in direct_ready
+                for oid in ids
+            )
             reply = self.conn.request({"type": "check_ready", "object_ids": ids})
-            ready_set = set(reply["ready"])
+            ready_set = set(reply["ready"]) | direct_ready
             if len(ready_set) >= num_returns or (
                 deadline is not None and time.monotonic() >= deadline
             ):
@@ -290,6 +596,9 @@ class CoreClient:
                 return ready, rest
             pending_ids = [i for i in ids if i not in ready_set]
             block = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if has_direct_pending:
+                # A direct future completing won't wake the GCS park; poll.
+                block = 0.05 if block is None else min(block, 0.05)
             try:
                 self.conn.request(
                     {"type": "wait_any", "object_ids": pending_ids}, timeout=block

@@ -46,12 +46,13 @@ PENDING, READY, FAILED = "PENDING", "READY", "FAILED"
 # Actor states (reference: src/ray/design_docs/actor_states.rst)
 A_PENDING, A_ALIVE, A_RESTARTING, A_DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
 # Worker states
-W_STARTING, W_IDLE, W_BUSY, W_ACTOR, W_DEAD = (
+W_STARTING, W_IDLE, W_BUSY, W_ACTOR, W_DEAD, W_LEASED = (
     "STARTING",
     "IDLE",
     "BUSY",
     "ACTOR",
     "DEAD",
+    "LEASED",
 )
 
 
@@ -84,6 +85,11 @@ class WorkerHandle:
     # _private/accelerators/tpu.py TPU_VISIBLE_CHIPS). Non-TPU workers
     # are pinned to CPU so they never contend for the chip.
     tpu: bool = False
+    # Direct actor-call socket served by the worker process (reference:
+    # actor calls bypass raylets — direct_actor_task_submitter.h).
+    direct_addr: str = ""
+    # Resources held while leased to a client (direct task transport).
+    lease_resources: Optional[Dict[str, float]] = None
 
 
 @dataclass
@@ -96,6 +102,8 @@ class ActorState:
     pending: deque = field(default_factory=deque)  # method specs buffered pre-ALIVE
     restarts_used: int = 0
     death_reason: str = ""
+    # Parked get_actor_direct lookups, answered on ALIVE/DEAD transition.
+    direct_waiters: List[Tuple[PeerConn, int]] = field(default_factory=list)
 
 
 @dataclass
@@ -264,6 +272,9 @@ class GcsServer:
             peer.start()
 
     def _on_peer_close(self, state: Dict[str, Any]):
+        # Release any worker leases the departing client still holds.
+        for leased_wid in state.pop("held_leases", set()):
+            self._release_lease(leased_wid)
         wid = state.get("worker_id")
         if wid is not None:
             self._handle_worker_death(wid, "worker connection closed")
@@ -332,6 +343,7 @@ class GcsServer:
                     node = self.nodes[w.node_id.binary()]
                 w.conn = peer
                 w.pid = msg.get("pid", 0)
+                w.direct_addr = msg.get("direct_addr", "")
                 w.state = W_IDLE
                 node.pool.add(wid)
                 node_id = node.node_id.binary()
@@ -453,7 +465,7 @@ class GcsServer:
             spec: Optional[TaskSpec] = w.inflight.get(task_id) if w else None
             self._record_task_event(
                 task_id,
-                spec.name if spec else "?",
+                spec.name if spec else msg.get("name", "?"),
                 "FAILED" if error_blob is not None else "FINISHED",
                 wid,
             )
@@ -513,6 +525,7 @@ class GcsServer:
                 node.pool.discard(wid)  # no longer fungible
             while actor.pending:
                 self._route_actor_task(actor.pending.popleft())
+            self._notify_direct_waiters(actor)
         else:
             actor.state = A_DEAD
             actor.death_reason = "creation task failed"
@@ -522,6 +535,7 @@ class GcsServer:
                 self._fail_task_returns(
                     actor.pending.popleft(), None, actor_error=actor.death_reason
                 )
+            self._notify_direct_waiters(actor)
             # The worker that failed construction is pinned but useless; let
             # it exit rather than leak one process per failed creation.
             if w is not None and w.state != W_DEAD:
@@ -704,6 +718,124 @@ class GcsServer:
                 max_concurrency=actor.spec.max_concurrency,
             )
 
+    def _h_lease_worker(self, state, msg):
+        """Grant an idle CPU worker to a client for direct task pushes
+        (reference: RequestWorkerLease, node_manager.cc:1794 — here at
+        burst granularity instead of per task). Resources stay acquired
+        until return_lease or worker death."""
+        res = {k: v for k, v in msg.get("resources", {}).items() if v > 0}
+        with self._lock:
+            lessee_node = self.nodes.get(state.get("obj_node_id", b""))
+            for node in self.nodes.values():
+                if not node.alive or not node.schedulable:
+                    continue
+                # Direct sockets are per-machine (unix paths): grant only
+                # workers the lessee can actually reach — its own node, or
+                # anywhere in the head's single-machine process tree
+                # (head + virtual nodes, conn is None).
+                reachable = lessee_node is not None and (
+                    node.node_id == lessee_node.node_id
+                    or (
+                        node.conn is None
+                        and lessee_node.conn is None
+                        and lessee_node.schedulable
+                    )
+                )
+                if not reachable:
+                    continue
+                if not _fits(node.available, res):
+                    continue
+                for wid in list(node.pool):
+                    w = self.workers.get(wid)
+                    if (
+                        w is not None
+                        and w.state == W_IDLE
+                        and w.conn is not None
+                        and not w.tpu
+                        and w.direct_addr
+                    ):
+                        _acquire(node.available, res)
+                        w.state = W_LEASED
+                        w.lease_resources = dict(res)
+                        # Tie the lease to the lessee's connection so a
+                        # dead client can't strand leased workers.
+                        state.setdefault("held_leases", set()).add(wid)
+                        state["peer"].reply(
+                            msg, ok=True, worker_id=wid, addr=w.direct_addr
+                        )
+                        return
+                # No idle worker here: prestart one for the next attempt.
+                starting = sum(
+                    1
+                    for w in self.workers.values()
+                    if w.node_id == node.node_id
+                    and w.state == W_STARTING
+                    and not w.tpu
+                )
+                pool_cpu = sum(
+                    1
+                    for wid in node.pool
+                    if (w := self.workers.get(wid)) is not None and not w.tpu
+                )
+                if pool_cpu + starting < max(int(node.total.get("CPU", 1)), 1):
+                    self._spawn_worker(node)
+            state["peer"].reply(msg, ok=True, addr=None)
+
+    def _h_return_lease(self, state, msg):
+        state.get("held_leases", set()).discard(msg["worker_id"])
+        self._release_lease(msg["worker_id"])
+
+    def _release_lease(self, wid: bytes):
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None or w.state != W_LEASED:
+                return
+            node = self.nodes.get(w.node_id.binary())
+            if node is not None and w.lease_resources:
+                _release(node.available, w.lease_resources)
+            w.lease_resources = None
+            w.state = W_IDLE
+            self._work.notify_all()
+
+    def _h_get_actor_direct(self, state, msg):
+        """Resolve an actor's direct-call socket. Restartable actors stay
+        on the GCS route (the direct conn can't survive a restart
+        transparently); lookups for PENDING actors park until the actor
+        is ALIVE or dead (the client buffers calls meanwhile)."""
+        with self._lock:
+            actor = self.actors.get(msg["actor_id"])
+            if actor is None or actor.state == A_DEAD:
+                state["peer"].reply(msg, ok=True, fallback=True)
+                return
+            if actor.spec.max_restarts > 0:
+                state["peer"].reply(msg, ok=True, fallback=True)
+                return
+            if actor.state != A_ALIVE or actor.worker_id is None:
+                actor.direct_waiters.append((state["peer"], msg["req_id"]))
+                return
+            self._answer_direct_waiter(actor, state["peer"], msg["req_id"])
+
+    def _answer_direct_waiter(self, actor: "ActorState", peer, req_id):
+        fields: Dict[str, Any] = {"ok": True}
+        w = (
+            self.workers.get(actor.worker_id.binary())
+            if actor.worker_id is not None
+            else None
+        )
+        if actor.state == A_ALIVE and w is not None and w.direct_addr:
+            fields["addr"] = w.direct_addr
+        else:
+            fields["fallback"] = True
+        try:
+            peer.send({"type": "reply", "req_id": req_id, **fields})
+        except ConnectionLost:
+            pass
+
+    def _notify_direct_waiters(self, actor: "ActorState"):
+        waiters, actor.direct_waiters = actor.direct_waiters, []
+        for peer, req_id in waiters:
+            self._answer_direct_waiter(actor, peer, req_id)
+
     def _h_kill_actor(self, state, msg):
         with self._lock:
             self._kill_actor(msg["actor_id"], reason=msg.get("reason", "ray.kill"))
@@ -720,6 +852,7 @@ class GcsServer:
             self.named_actors.pop(actor.name, None)
         while actor.pending:
             self._fail_task_returns(actor.pending.popleft(), None, actor_error=reason)
+        self._notify_direct_waiters(actor)
         if actor.worker_id is not None:
             w = self.workers.get(actor.worker_id.binary())
             if w is not None and w.state != W_DEAD:
@@ -1366,6 +1499,10 @@ class GcsServer:
             if w.current_task is not None:
                 self._release_task_resources(w.current_task, w.node_id)
                 w.current_task = None
+            if w.lease_resources:
+                if node is not None:
+                    _release(node.available, w.lease_resources)
+                w.lease_resources = None
             inflight, w.inflight = dict(w.inflight), {}
             for spec in inflight.values():
                 if spec.actor_id is not None and not spec.actor_creation:
@@ -1404,6 +1541,7 @@ class GcsServer:
                                 actor.pending.popleft(), None,
                                 actor_error=actor.death_reason,
                             )
+                        self._notify_direct_waiters(actor)
             self._work.notify_all()
         if w.proc is not None:
             threading.Thread(target=_reap, args=(w.proc,), daemon=True).start()

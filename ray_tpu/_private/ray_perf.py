@@ -1,0 +1,314 @@
+"""Core-runtime microbenchmarks.
+
+Reference: python/ray/_private/ray_perf.py — the `ray microbenchmark`
+suite whose published numbers (release/perf_metrics/microbenchmark.json,
+mirrored in BASELINE.md) define the reference's core-runtime envelope:
+task submission, actor calls, object put/get, placement groups.
+
+Run: python -m ray_tpu._private.ray_perf [--out PERF.json]
+Each benchmark prints one line; --out writes the full JSON map.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+RESULTS: Dict[str, float] = {}
+
+# Reference numbers from release/perf_metrics/microbenchmark.json @2.31.0
+# (BASELINE.md); ratio >= 1.0 means this runtime matches or beats them.
+BASELINE = {
+    "single_client_tasks_sync": 987,
+    "single_client_tasks_async": 7955,
+    "multi_client_tasks_async": 23558,
+    "1_1_actor_calls_sync": 2058,
+    "1_1_actor_calls_async": 8334,
+    "1_1_actor_calls_concurrent": 5129,
+    "1_n_actor_calls_async": 8762,
+    "n_n_actor_calls_async": 27658,
+    "n_n_actor_calls_with_arg_async": 2713,
+    "1_1_async_actor_calls_sync": 1375,
+    "1_1_async_actor_calls_async": 3257,
+    "single_client_get_calls": 10594,
+    "single_client_put_calls": 5301,
+    "single_client_put_gigabytes": 20.3,
+    "single_client_wait_1k_refs": 5.4,
+    "placement_group_create/removal": 841,
+}
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: int = 1,
+           min_time: float = 2.0) -> float:
+    """ops/s of fn (which performs `multiplier` ops per call)."""
+    # Warm up for ~3s: spawning workers and growing the lease pool takes
+    # a few seconds; the measurement window must see steady state.
+    warm_start = time.perf_counter()
+    while time.perf_counter() - warm_start < 3.0:
+        fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    RESULTS[name] = round(rate, 2)
+    print(f"{name}: {rate:,.1f} /s")
+    return rate
+
+
+@ray_tpu.remote
+def tiny_task():
+    return b"ok"
+
+
+@ray_tpu.remote
+class Counter:
+    def small_value(self):
+        return b"ok"
+
+    def small_value_arg(self, x):
+        return b"ok"
+
+
+@ray_tpu.remote
+class AsyncCounter:
+    async def small_value(self):
+        return b"ok"
+
+
+@ray_tpu.remote
+class CallerActor:
+    """Drives a target actor from its own process (the reference's n:n
+    benchmarks use actor clients, not driver threads — ray_perf.py)."""
+
+    def __init__(self, target):
+        self.target = target
+
+    def drive(self, n, arg=None):
+        import ray_tpu as rt
+
+        if arg is not None:
+            rt.get([self.target.small_value_arg.remote(arg) for _ in range(n)])
+        else:
+            rt.get([self.target.small_value.remote() for _ in range(n)])
+        return n
+
+
+@ray_tpu.remote
+class TaskClient:
+    """Submits tiny tasks from its own process (multi_client_tasks)."""
+
+    def drive(self, n):
+        import ray_tpu as rt
+
+        rt.get([tiny_task.remote() for _ in range(n)])
+        return n
+
+
+def bench_tasks():
+    def single_sync():
+        ray_tpu.get(tiny_task.remote())
+
+    timeit("single_client_tasks_sync", single_sync)
+
+    batch = 500
+    def single_async():
+        ray_tpu.get([tiny_task.remote() for _ in range(batch)])
+
+    timeit("single_client_tasks_async", single_async, multiplier=batch)
+
+    n = 4
+    clients = [TaskClient.remote() for _ in range(n)]
+    ray_tpu.get([c.drive.remote(1) for c in clients])
+    per = 250
+
+    def multi_async():
+        ray_tpu.get([c.drive.remote(per) for c in clients])
+
+    timeit("multi_client_tasks_async", multi_async, multiplier=n * per)
+    for c in clients:
+        ray_tpu.kill(c)
+
+
+def bench_actor_calls():
+    a = Counter.remote()
+    ray_tpu.get(a.small_value.remote())
+
+    def sync_call():
+        ray_tpu.get(a.small_value.remote())
+
+    timeit("1_1_actor_calls_sync", sync_call)
+
+    batch = 500
+    def async_call():
+        ray_tpu.get([a.small_value.remote() for _ in range(batch)])
+
+    timeit("1_1_actor_calls_async", async_call, multiplier=batch)
+
+    c = Counter.options(max_concurrency=16).remote()
+    ray_tpu.get(c.small_value.remote())
+
+    def concurrent_call():
+        ray_tpu.get([c.small_value.remote() for _ in range(batch)])
+
+    timeit("1_1_actor_calls_concurrent", concurrent_call, multiplier=batch)
+
+    n = 8
+    actors = [Counter.remote() for _ in range(n)]
+    ray_tpu.get([b.small_value.remote() for b in actors])
+
+    def one_n():
+        ray_tpu.get(
+            [b.small_value.remote() for b in actors for _ in range(64)]
+        )
+
+    timeit("1_n_actor_calls_async", one_n, multiplier=n * 64)
+
+    # n:n — n caller actors (own processes) each driving its own target.
+    callers = [CallerActor.remote(b) for b in actors]
+    ray_tpu.get([c.drive.remote(1) for c in callers])
+    per = 125
+
+    def n_n():
+        ray_tpu.get([c.drive.remote(per) for c in callers])
+
+    timeit("n_n_actor_calls_async", n_n, multiplier=n * per)
+
+    arr = np.zeros(100 * 1024, dtype=np.uint8)
+    per_arg = 32
+
+    def n_n_arg():
+        ray_tpu.get([c.drive.remote(per_arg, arr) for c in callers])
+
+    timeit("n_n_actor_calls_with_arg_async", n_n_arg, multiplier=n * per_arg)
+    for c in callers:
+        ray_tpu.kill(c)
+
+    aa = AsyncCounter.remote()
+    ray_tpu.get(aa.small_value.remote())
+
+    def async_actor_sync():
+        ray_tpu.get(aa.small_value.remote())
+
+    timeit("1_1_async_actor_calls_sync", async_actor_sync)
+
+    batch = 500
+    def async_actor_async():
+        ray_tpu.get([aa.small_value.remote() for _ in range(batch)])
+
+    timeit("1_1_async_actor_calls_async", async_actor_async, multiplier=batch)
+
+    for b in actors + [a, c, aa]:
+        ray_tpu.kill(b)
+
+
+def bench_objects():
+    small = np.zeros(10 * 1024, dtype=np.uint8)  # 10 KiB: plasma path
+    big = np.zeros(200 * 1024, dtype=np.uint8)  # >inline cap: shm path
+    refs = [ray_tpu.put(big) for _ in range(10)]
+
+    def get_calls():
+        for ref in refs:
+            ray_tpu.get(ref)
+
+    timeit("single_client_get_calls", get_calls, multiplier=len(refs))
+
+    put_refs: List = []
+
+    def put_calls():
+        for _ in range(10):
+            put_refs.append(ray_tpu.put(small))
+
+    timeit("single_client_put_calls", put_calls, multiplier=10)
+    ray_tpu.free(put_refs)
+    ray_tpu.free(refs)
+
+    chunk = np.zeros(100 * 1024 * 1024, dtype=np.uint8)  # 100 MiB
+
+    def put_gb():
+        r = ray_tpu.put(chunk)
+        ray_tpu.free([r])
+
+    rate = timeit("single_client_put_calls_100MiB", put_gb, min_time=3.0)
+    RESULTS["single_client_put_gigabytes"] = round(
+        rate * len(chunk) / (1 << 30), 3
+    )
+    print(
+        f"single_client_put_gigabytes: "
+        f"{RESULTS['single_client_put_gigabytes']} GiB/s"
+    )
+
+    refs1k = [ray_tpu.put(b"x") for _ in range(1000)]
+
+    def wait_1k():
+        ray_tpu.wait(refs1k, num_returns=len(refs1k))
+
+    timeit("single_client_wait_1k_refs", wait_1k)
+    ray_tpu.free(refs1k)
+
+
+def bench_placement_groups():
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    def create_remove():
+        pg = placement_group([{"CPU": 0.01}], strategy="PACK")
+        pg.wait(timeout_seconds=10)
+        remove_placement_group(pg)
+
+    timeit("placement_group_create/removal", create_remove)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="write JSON results here")
+    parser.add_argument("--num-cpus", type=int, default=8)
+    parser.add_argument(
+        "--only", default=None,
+        help="comma-separated subset: tasks,actors,objects,pgs",
+    )
+    args = parser.parse_args(argv)
+
+    ray_tpu.init(num_cpus=args.num_cpus)
+    groups = {
+        "tasks": bench_tasks,
+        "actors": bench_actor_calls,
+        "objects": bench_objects,
+        "pgs": bench_placement_groups,
+    }
+    selected = (
+        [s.strip() for s in args.only.split(",")] if args.only else list(groups)
+    )
+    t0 = time.time()
+    for name in selected:
+        groups[name]()
+    RESULTS["_wall_seconds"] = round(time.time() - t0, 1)
+    if args.out:
+        out = {
+            "results": RESULTS,
+            "vs_baseline": {
+                k: round(RESULTS[k] / BASELINE[k], 3)
+                for k in BASELINE
+                if k in RESULTS
+            },
+            "baseline_source": "BASELINE.md (reference microbenchmark @2.31.0)",
+        }
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+        print(f"wrote {args.out}")
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

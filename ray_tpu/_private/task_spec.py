@@ -54,7 +54,23 @@ class TaskSpec:
     def scheduling_class(self) -> Tuple[Tuple[str, float], ...]:
         return tuple(sorted(self.resources.items()))
 
+    def __getstate__(self):
+        # Drop the return-id cache from the wire format.
+        state = dict(self.__dict__)
+        state.pop("_return_ids", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
     def return_object_ids(self) -> List[ObjectID]:
-        return [
-            ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
-        ]
+        # Cached: recomputed on the submit hot path otherwise (deterministic
+        # from task_id, so caching across pickling is safe).
+        ids = getattr(self, "_return_ids", None)
+        if ids is None:
+            ids = [
+                ObjectID.for_task_return(self.task_id, i)
+                for i in range(self.num_returns)
+            ]
+            object.__setattr__(self, "_return_ids", ids)
+        return ids

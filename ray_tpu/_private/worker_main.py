@@ -36,7 +36,11 @@ from ..object_ref import ObjectRef
 
 
 class WorkerRuntime:
-    def __init__(self, client: CoreClient, task_queue: "queue.Queue[Optional[TaskSpec]]"):
+    def __init__(self, client: CoreClient, task_queue):
+        # task_queue holds (spec, origin); origin None = GCS-routed,
+        # (peer, msg) = direct actor call to answer on that connection
+        # (reference: direct actor transport bypassing raylet+GCS,
+        # transport/direct_actor_task_submitter.h).
         self.client = client
         self.task_queue = task_queue
         self.fn_cache: Dict[bytes, Any] = {}
@@ -98,7 +102,7 @@ class WorkerRuntime:
                     {"type": "actor_exit", "actor_id": spec.actor_id.binary()}
                 )
                 self._done.set()
-                self.task_queue.put(None)
+                self.task_queue.put((None, None))
                 return None
             args, kwargs = self._resolve_args(spec)
             if spec.method_name == "__ray_apply__":
@@ -137,7 +141,7 @@ class WorkerRuntime:
         fn = self._resolve_function(spec)
         return fn(*args, **kwargs)
 
-    def _submit_async(self, spec: TaskSpec):
+    def _submit_async(self, spec: TaskSpec, origin=None):
         """Run a coroutine method on the actor's event loop without blocking
         the dispatch thread — async actor calls execute concurrently
         (reference: fiber-based async actors, transport/fiber.h:17)."""
@@ -153,14 +157,15 @@ class WorkerRuntime:
             return await method(*args, **kwargs)
 
         fut = asyncio.run_coroutine_threadsafe(runner(), self._aio_loop)
-        fut.add_done_callback(lambda f: self._finish_async(spec, f))
+        fut.add_done_callback(lambda f: self._finish_async(spec, f, origin))
 
-    def _finish_async(self, spec: TaskSpec, fut):
+    def _finish_async(self, spec: TaskSpec, fut, origin=None):
         exc = fut.exception()
         value = None if exc is not None else fut.result()
-        self._report_done(spec, value, exc)
+        self._report_done(spec, value, exc, origin)
 
-    def _report_done(self, spec: TaskSpec, value: Any, exc: Optional[BaseException]):
+    def _report_done(self, spec: TaskSpec, value: Any,
+                     exc: Optional[BaseException], origin=None):
         return_ids = spec.return_object_ids()
         results = [{"object_id": oid.binary()} for oid in return_ids]
         error_blob = None
@@ -203,43 +208,63 @@ class WorkerRuntime:
                             self.client.store, oid, payload, buffers, size
                         )
                         results[i].update(segment=name, size=size)
+        if origin is not None:
+            # Direct actor call: answer on the caller's connection.
+            # Results ride inline in the reply; larger values are sealed
+            # into the store and the caller reads them by location. The
+            # GCS still gets a fire-and-forget task_done so the object
+            # directory stays coherent for refs shared with other
+            # processes (wait/free/args).
+            peer, req_msg = origin
+            from .protocol import ConnectionLost
+
+            try:
+                if error_blob is not None:
+                    peer.reply(req_msg, error=error_blob)
+                else:
+                    peer.reply(req_msg, error=None, results=results)
+            except ConnectionLost:
+                pass
         msg = {
             "type": "task_done",
             "worker_id": self.client.worker_id.binary(),
             "task_id": spec.task_id.binary(),
+            "name": spec.name,
             "results": results,
             "error": error_blob,
         }
+        if origin is not None:
+            msg["direct"] = True
         if spec.actor_creation:
             msg["actor_creation"] = True
             msg["actor_id"] = spec.actor_id.binary()
         self.client.send(msg)
 
-    def _execute(self, spec: TaskSpec):
+    def _execute(self, spec: TaskSpec, origin=None):
         try:
             value = self._run_user_code(spec)
             exc = None
         except BaseException as e:  # noqa: BLE001
             value, exc = None, e
-        self._report_done(spec, value, exc)
+        self._report_done(spec, value, exc, origin)
 
     # ------------------------------------------------------------------- loop
 
     def run(self):
         while not self._done.is_set():
-            spec = self.task_queue.get()
+            spec, origin = self.task_queue.get()
             if spec is None:
                 break
             is_actor_method = spec.actor_id is not None and not spec.actor_creation
             if is_actor_method and spec.method_name != "__ray_terminate__":
                 method = getattr(self.actor_instance, spec.method_name, None)
                 if method is not None and asyncio.iscoroutinefunction(method):
-                    self._submit_async(spec)
+                    self._submit_async(spec, origin)
                     continue
                 if self._pool is not None:
-                    self._pool.submit(self._execute, spec)
+                    self._pool.submit(self._execute, spec, origin)
                     continue
-            self._execute(spec)
+            self._execute(spec, origin)
 
 
 def main():
@@ -249,18 +274,56 @@ def main():
 
     # The queue exists before the connection: the GCS may push a task the
     # instant our hello registers, on the reader thread.
-    task_queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
+    task_queue: "queue.Queue" = queue.Queue()
 
     def push(msg):
         t = msg["type"]
         if t == "execute_task":
-            task_queue.put(msg["spec"])
+            task_queue.put((msg["spec"], None))
         elif t == "exit":
-            task_queue.put(None)
+            task_queue.put((None, None))
+
+    # Direct actor-call listener: callers connect here and push
+    # execute_task without a GCS hop; replies carry results back on the
+    # same connection (reference: actor calls gRPC straight to the actor
+    # process, transport/direct_actor_task_submitter.h).
+    from multiprocessing.connection import Listener
+
+    from .protocol import PeerConn
+
+    direct_addr = f"/tmp/rtpu-w-{worker_id.hex()[:12]}.sock"
+    try:
+        os.unlink(direct_addr)
+    except FileNotFoundError:
+        pass
+    direct_listener = Listener(direct_addr, family="AF_UNIX", authkey=authkey)
+
+    def direct_accept_loop():
+        while True:
+            try:
+                conn = direct_listener.accept()
+            except (OSError, EOFError):
+                return
+            except Exception:  # noqa: BLE001 - failed auth handshake etc.
+                continue
+            holder = {}
+
+            def on_direct(msg, h=holder):
+                if msg.get("type") == "execute_task":
+                    task_queue.put((msg["spec"], (h["peer"], msg)))
+
+            peer = PeerConn(
+                conn, push_handler=on_direct, name="direct-serve",
+                autostart=False,
+            )
+            holder["peer"] = peer
+            peer.start()
+
+    threading.Thread(target=direct_accept_loop, daemon=True).start()
 
     client = CoreClient(
         address, authkey, role="worker", worker_id=worker_id,
-        push_handler=push,
+        push_handler=push, direct_addr=direct_addr,
     )
     rt = WorkerRuntime(client, task_queue)
 

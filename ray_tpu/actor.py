@@ -72,7 +72,12 @@ class ActorMethod:
             actor_id=self._handle._actor_id,
             method_name=self._method_name,
         )
-        refs = client.submit(spec)
+        # Direct transport first (no GCS hop; reference: actor calls go
+        # gRPC straight to the actor process); None means route via GCS
+        # (restartable actors, actor still pending, remote socket).
+        refs = client.submit_actor_direct(spec)
+        if refs is None:
+            refs = client.submit(spec)
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -108,5 +108,10 @@ class RemoteFunction:
                 client,
             ),
         )
-        refs = client.submit(spec)
+        # Leased direct transport for plain tasks (no deps/PG/TPU); falls
+        # back to GCS-routed scheduling (reference: direct task submitter
+        # vs GCS-scheduled tasks, direct_task_transport.cc:24).
+        refs = client.submit_task_leased(spec)
+        if refs is None:
+            refs = client.submit(spec)
         return refs[0] if num_returns == 1 else refs

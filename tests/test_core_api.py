@@ -167,3 +167,58 @@ def test_python_objects_with_refs_inside_returns(ray_start):
     outer_ref = make_ref.remote()
     inner_ref = ray_tpu.get(outer_ref)
     assert ray_tpu.get(inner_ref) == "inner"
+
+
+def test_leases_released_when_client_dies(ray_start):
+    # Nested clients (actors submitting tasks) lease workers for the
+    # direct task transport; killing the client must give the leased
+    # workers (and their resources) back (reference: leases are tied to
+    # the lessee in direct_task_transport.cc).
+    import time
+
+    @ray_tpu.remote
+    def tiny():
+        return 1
+
+    @ray_tpu.remote
+    class Submitter:
+        def drive(self, n):
+            return sum(ray_tpu.get([tiny.remote() for _ in range(n)]))
+
+    total = ray_tpu.cluster_resources().get("CPU", 0)
+    s = Submitter.remote()
+    assert ray_tpu.get(s.drive.remote(20)) == 20
+    ray_tpu.kill(s)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == total:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources().get("CPU", 0) == total
+
+
+def test_direct_task_transport_error_and_retry(ray_start):
+    # Errors propagate through the leased path; worker death mid-task
+    # falls back to GCS rescheduling (system retries).
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("nope")
+
+    # warm the function (first call registers blob via GCS) then leased
+    with pytest.raises(ValueError):
+        ray_tpu.get(boom.remote())
+    with pytest.raises(ValueError):
+        ray_tpu.get(boom.remote())
+
+    @ray_tpu.remote(max_retries=2)
+    def die_once():
+        import os
+        import ray_tpu as rt
+
+        marker = b"died_once_marker"
+        if not rt._private.worker.global_client().kv_get(marker):
+            rt._private.worker.global_client().kv_put(marker, b"1")
+            os._exit(1)
+        return "recovered"
+
+    assert ray_tpu.get(die_once.remote(), timeout=60) == "recovered"
