@@ -21,6 +21,7 @@
 // first-fit with splitting and bidirectional coalescing), 64-byte
 // alignment so payloads are cache-line- and dlpack-friendly.
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdio>
 #include <cstdint>
@@ -30,6 +31,8 @@
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
+#include <utility>
+#include <vector>
 
 namespace {
 
@@ -436,6 +439,35 @@ int32_t store_delete(uint64_t handle, const uint8_t* id) {
   free_entry(s, e);
   unlock(s);
   return 0;
+}
+
+// List sealed refcount-0 objects in LRU order until their sizes sum to
+// at least `need` bytes (spill victim selection — reference:
+// LocalObjectManager::SpillObjectsOfSize, local_object_manager.h:100).
+// Writes up to max_out ids (16 bytes each) into out_ids; returns count.
+int32_t store_lru_candidates(uint64_t handle, uint64_t need,
+                             uint8_t* out_ids, int32_t max_out) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  std::vector<std::pair<uint64_t, uint32_t>> eligible;  // (lru, slot)
+  lock(s);
+  for (uint32_t i = 0; i < s->h->max_objects; ++i) {
+    Entry* e = &table(s)[i];
+    if (e->state == kStateSealed && e->refcount == 0) {
+      eligible.emplace_back(e->lru, i);
+    }
+  }
+  std::sort(eligible.begin(), eligible.end());
+  int32_t count = 0;
+  uint64_t gathered = 0;
+  for (auto& [lru, i] : eligible) {
+    if (count >= max_out || gathered >= need) break;
+    Entry* e = &table(s)[i];
+    std::memcpy(out_ids + 16 * count, e->id, 16);
+    gathered += e->size;
+    ++count;
+  }
+  unlock(s);
+  return count;
 }
 
 void store_stats(uint64_t handle, uint64_t* out8) {

@@ -86,6 +86,15 @@ class CoreClient:
         self._leases: Dict[Any, list] = {}
         self._lease_grow_failed_at: Dict[Any, float] = {}
         self._lease_reaper: Optional[threading.Thread] = None
+        # Distributed refcounting + lineage (reference_count.h:61,
+        # task_manager.h:269): live ObjectRef instances in this process
+        # feed the tracker; specs this client submitted are retained for
+        # reconstruction until their return refs die.
+        from .ref_tracker import RefTracker, set_current
+
+        self._lineage: Dict[bytes, TaskSpec] = {}
+        self._tracker = RefTracker(self)
+        set_current(self._tracker)
 
     def _on_push(self, msg: Dict[str, Any]):
         self._push_handler(msg)
@@ -106,7 +115,13 @@ class CoreClient:
             raise RayTpuError(f"function {function_id.hex()} not found in GCS")
         return reply["blob"]
 
+    def _record_lineage(self, spec: TaskSpec) -> None:
+        if spec.actor_id is None:
+            for oid in spec.return_object_ids():
+                self._lineage[oid.binary()] = spec
+
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
+        self._record_lineage(spec)
         self.conn.send({"type": "submit_task", "spec": spec})
         owner = self.worker_id.binary()
         return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
@@ -210,6 +225,7 @@ class CoreClient:
         """Caller must have already claimed a slot (outstanding += 1)."""
         from concurrent.futures import Future
 
+        self._record_lineage(spec)
         oids = [oid.binary() for oid in spec.return_object_ids()]
         with self._lease_lock:
             for ob in oids:
@@ -472,8 +488,11 @@ class CoreClient:
         """Seal a value; small values inline through the GCS, large ones go
         to the shm store (reference: max_direct_call_object_size split
         between memory store and plasma)."""
+        from ..object_ref import _CaptureRefs
+
         value = serialization.prepare_value(value)
-        payload, buffers = serialization.dumps(value)
+        with _CaptureRefs() as cap:
+            payload, buffers = serialization.dumps(value)
         size = serialization.serialized_size(payload, buffers)
         if size <= RayConfig.max_inline_object_size:
             blob = bytearray(size)
@@ -482,17 +501,25 @@ class CoreClient:
         else:
             name = object_segment_put(self.store, oid, payload, buffers, size)
             fields = {"object_id": oid.binary(), "segment": name, "size": size}
+        if cap.seen:
+            # Refs nested inside the stored value: the directory pins them
+            # while this object lives (borrowing — reference_count.h:61).
+            fields["children"] = cap.seen
         reply = self.conn.request({"type": "put_object", **fields})
         if not reply.get("ok"):
             raise RayTpuError(f"put failed: {reply}")
         return fields
 
     def _materialize(self, reply: Dict[str, Any], oid: ObjectID) -> Any:
+        from ..exceptions import ObjectLostError
+
         if reply.get("status") == "FAILED":
             err = serialization.unpack(reply["error"])
             if isinstance(err, RayTaskError):
                 raise err.as_instanceof_cause()
             raise err
+        if reply.get("status") == "LOST":
+            raise ObjectLostError(f"object {oid.hex()} lost (node died)")
         if reply.get("inline") is not None:
             return serialization.unpack(reply["inline"])
         # Cross-node: the object's primary copy lives on another node —
@@ -506,13 +533,41 @@ class CoreClient:
         ):
             addr = reply.get("transfer_addr")
             if not addr or not self._fetcher.pull(oid, addr):
-                from ..exceptions import ObjectLostError
-
                 raise ObjectLostError(
                     f"object {oid.hex()} on node "
                     f"{owner_node.hex()[:8]} could not be fetched"
                 )
-        return self.store.get(oid)
+        try:
+            return self.store.get(oid)
+        except FileNotFoundError:
+            # Directory says READY but the data is gone (evicted).
+            raise ObjectLostError(
+                f"object {oid.hex()} missing from the local store (evicted)"
+            ) from None
+
+    def _materialize_or_reconstruct(
+        self, reply: Dict[str, Any], ref: ObjectRef, remaining: Optional[float]
+    ) -> Any:
+        """Materialize; on loss, resubmit the producing task from lineage
+        and retry (reference: ObjectRecoveryManager
+        object_recovery_manager.h:41 + TaskManager::ResubmitTask
+        task_manager.h:269 — the owner reconstructs)."""
+        from ..exceptions import ObjectLostError
+
+        oid = ref.id()
+        for _ in range(3):
+            try:
+                return self._materialize(reply, oid)
+            except ObjectLostError:
+                spec = self._lineage.get(oid.binary())
+                if spec is None:
+                    raise
+                self.conn.send({"type": "submit_task", "spec": spec})
+                reply = self.conn.request(
+                    {"type": "get_object", "object_id": oid.binary()},
+                    timeout=remaining,
+                )
+        return self._materialize(reply, oid)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -561,7 +616,7 @@ class CoreClient:
                         {"type": "get_object", "object_id": oid.binary()},
                         timeout=remaining,
                     )
-            out.append(self._materialize(reply, ref.id()))
+            out.append(self._materialize_or_reconstruct(reply, ref, remaining))
         return out
 
     def wait(

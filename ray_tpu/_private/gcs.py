@@ -42,7 +42,7 @@ from .protocol import ConnectionLost, PeerConn
 from .task_spec import TaskSpec
 
 # Object status
-PENDING, READY, FAILED = "PENDING", "READY", "FAILED"
+PENDING, READY, FAILED, LOST = "PENDING", "READY", "FAILED", "LOST"
 # Actor states (reference: src/ray/design_docs/actor_states.rst)
 A_PENDING, A_ALIVE, A_RESTARTING, A_DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
 # Worker states
@@ -66,6 +66,14 @@ class ObjectEntry:
     node_id: Optional[NodeID] = None
     # (peer, req_id) blocked gets to answer on seal.
     waiters: List[Tuple[PeerConn, int]] = field(default_factory=list)
+    # Distributed refcounting (reference: reference_count.h:61): which
+    # clients hold live ObjectRef instances; pins from in-flight task
+    # dependencies and from parent objects whose values embed this ref.
+    holders: Set[bytes] = field(default_factory=set)
+    had_holder: bool = False
+    task_pins: int = 0
+    child_pins: int = 0
+    children: List[bytes] = field(default_factory=list)
 
 
 @dataclass
@@ -275,6 +283,9 @@ class GcsServer:
         # Release any worker leases the departing client still holds.
         for leased_wid in state.pop("held_leases", set()):
             self._release_lease(leased_wid)
+        cid = state.get("client_id")
+        if cid is not None:
+            self._sweep_client_refs(cid)
         wid = state.get("worker_id")
         if wid is not None:
             self._handle_worker_death(wid, "worker connection closed")
@@ -365,8 +376,10 @@ class GcsServer:
                 self.nodes[dnode.node_id.binary()] = dnode
                 node_id = dnode.node_id.binary()
                 state["node_id"] = node_id  # dies with this connection
-        # Where this peer's sealed objects live (put_object routing).
+        # Where this peer's sealed objects live (put_object routing), and
+        # its identity for refcount bookkeeping.
         state["obj_node_id"] = node_id
+        state["client_id"] = msg["worker_id"]
         peer.reply(
             msg, ok=True, session_dir=self.session_dir, node_id=node_id
         )
@@ -398,7 +411,18 @@ class GcsServer:
                 self.functions.setdefault(spec.function_id, spec.function_blob)
                 spec.function_blob = None
             for oid in spec.return_object_ids():
-                self.objects.setdefault(oid.binary(), ObjectEntry())
+                entry = self.objects.setdefault(oid.binary(), ObjectEntry())
+                if entry.status in (READY, LOST):
+                    # Owner resubmission after loss (lineage
+                    # reconstruction): the task will reseal its returns.
+                    entry.status = PENDING
+                    entry.inline = None
+                    entry.segment = None
+                    entry.error = None
+            # Pin dependencies for the task's lifetime so a holderless
+            # intermediate can't be reclaimed mid-flight.
+            for dep in spec.dependencies:
+                self.objects.setdefault(dep.binary(), ObjectEntry()).task_pins += 1
             if spec.actor_id is not None and not spec.actor_creation:
                 self._route_actor_task(spec)
             else:
@@ -459,6 +483,7 @@ class GcsServer:
         wid = msg["worker_id"]
         results = msg["results"]  # list of dicts per return
         error_blob = msg.get("error")
+        freed: List[bytes] = []
         with self._lock:
             w = self.workers.get(wid)
             task_id = msg["task_id"]
@@ -504,11 +529,26 @@ class GcsServer:
                     entry.segment = r.get("segment")
                     entry.size = r.get("size", 0)
                     entry.node_id = w.node_id if w else None
+                    for child in r.get("children", []):
+                        entry.children.append(child)
+                        self.objects.setdefault(
+                            child, ObjectEntry()
+                        ).child_pins += 1
                 self._notify_object(entry)
+                # Refs already dropped before the result sealed: reclaim.
+                self._maybe_free(r["object_id"], entry, freed)
+            # Task terminal: release its dependency pins.
+            if spec is not None:
+                for dep in spec.dependencies:
+                    de = self.objects.get(dep.binary())
+                    if de is not None:
+                        de.task_pins = max(0, de.task_pins - 1)
+                        self._maybe_free(dep.binary(), de, freed)
             if msg.get("actor_creation"):
                 self._on_actor_created(msg["actor_id"], wid, ok=error_blob is None,
                                        error_blob=error_blob)
             self._work.notify_all()
+        self._broadcast_free(freed)
 
     def _on_actor_created(self, aid: bytes, wid: bytes, ok: bool, error_blob=None):
         actor = self.actors.get(aid)
@@ -558,12 +598,17 @@ class GcsServer:
             if entry.segment is not None:
                 nid = state.get("obj_node_id")
                 entry.node_id = NodeID(nid) if nid else self.head_node.node_id
+            for child in msg.get("children", []):
+                entry.children.append(child)
+                self.objects.setdefault(child, ObjectEntry()).child_pins += 1
             self._notify_object(entry)
         state["peer"].reply(msg, ok=True)
 
     def _object_reply_fields(self, entry: ObjectEntry) -> Dict[str, Any]:
         if entry.status == FAILED:
             return {"ok": True, "status": FAILED, "error": entry.error}
+        if entry.status == LOST:
+            return {"ok": True, "status": LOST}
         fields = {
             "ok": True,
             "status": READY,
@@ -620,23 +665,86 @@ class GcsServer:
             for oid in msg["object_ids"]:
                 self.objects[oid].waiters.append((peer, msg["req_id"]))
 
-    def _h_free_objects(self, state, msg):
-        daemons = []
+    def _free_entry(self, oid: bytes, freed: List[bytes]) -> None:
+        """Drop an entry, cascading child unpins (must hold the lock)."""
+        entry = self.objects.pop(oid, None)
+        if entry is None:
+            return
+        if entry.segment:
+            self._store.delete(ObjectID(oid))
+        freed.append(oid)
+        for child in entry.children:
+            ce = self.objects.get(child)
+            if ce is not None:
+                ce.child_pins = max(0, ce.child_pins - 1)
+                self._maybe_free(child, ce, freed)
+
+    def _maybe_free(self, oid: bytes, entry: ObjectEntry, freed: List[bytes]) -> None:
+        """Auto-free when the last holder is gone and nothing pins the
+        entry (must hold the lock). Only entries that have had a holder
+        qualify — a fresh result whose add_ref batch hasn't landed yet
+        must not be reclaimed."""
+        if (
+            entry.had_holder
+            and not entry.holders
+            and entry.task_pins <= 0
+            and entry.child_pins <= 0
+            and entry.status != PENDING
+            and not entry.waiters
+        ):
+            self._free_entry(oid, freed)
+
+    def _broadcast_free(self, freed: List[bytes]) -> None:
+        if not freed:
+            return
         with self._lock:
-            for oid in msg["object_ids"]:
-                entry = self.objects.pop(oid, None)
-                if entry is not None and entry.segment:
-                    self._store.delete(ObjectID(oid))
             daemons = [
                 n.conn for n in self.nodes.values() if n.alive and n.conn is not None
             ]
-        # Fan the free out to every node daemon: each drops its local copy
-        # (primary or pulled replica) from its pool.
         for conn in daemons:
             try:
-                conn.send({"type": "free_objects", "object_ids": msg["object_ids"]})
+                conn.send({"type": "free_objects", "object_ids": freed})
             except ConnectionLost:
                 pass
+
+    def _h_update_refs(self, state, msg):
+        """Batched 0<->1 refcount transitions from one client
+        (reference: reference_count.h — here centralized in the
+        directory as per-object holder sets)."""
+        cid = msg["client"]
+        freed: List[bytes] = []
+        with self._lock:
+            for oid in msg.get("add", []):
+                entry = self.objects.setdefault(oid, ObjectEntry())
+                entry.holders.add(cid)
+                entry.had_holder = True
+            for oid in msg.get("remove", []):
+                entry = self.objects.get(oid)
+                if entry is None:
+                    continue
+                # A removal implies the client held the ref, even if its
+                # add was compressed away within one flush window.
+                entry.had_holder = True
+                entry.holders.discard(cid)
+                self._maybe_free(oid, entry, freed)
+        self._broadcast_free(freed)
+
+    def _sweep_client_refs(self, cid: bytes) -> None:
+        """A client process is gone: drop every ref it held."""
+        freed: List[bytes] = []
+        with self._lock:
+            for oid, entry in list(self.objects.items()):
+                if cid in entry.holders:
+                    entry.holders.discard(cid)
+                    self._maybe_free(oid, entry, freed)
+        self._broadcast_free(freed)
+
+    def _h_free_objects(self, state, msg):
+        freed: List[bytes] = []
+        with self._lock:
+            for oid in msg["object_ids"]:
+                self._free_entry(oid, freed)
+        self._broadcast_free(list(set(freed) | set(msg["object_ids"])))
         if "req_id" in msg:
             state["peer"].reply(msg, ok=True)
 
@@ -1203,6 +1311,18 @@ class GcsServer:
                 return
             node.alive = False
             node.conn = None
+            # Objects whose primary copy lived on the dead node are LOST;
+            # owners reconstruct them from lineage on the next get
+            # (reference: object_recovery_manager.h:41).
+            for entry in self.objects.values():
+                if (
+                    entry.status == READY
+                    and entry.segment is not None
+                    and entry.node_id is not None
+                    and entry.node_id.binary() == nid
+                ):
+                    entry.status = LOST
+                    self._notify_object(entry)
             dead_workers = [
                 w
                 for w in self.workers.values()
@@ -1268,6 +1388,15 @@ class GcsServer:
             entry.status = FAILED
             entry.error = error_blob
             self._notify_object(entry)
+        # Terminal: release dependency pins.
+        freed: List[bytes] = []
+        for dep in spec.dependencies:
+            de = self.objects.get(dep.binary())
+            if de is not None:
+                de.task_pins = max(0, de.task_pins - 1)
+                self._maybe_free(dep.binary(), de, freed)
+        if freed:
+            self._broadcast_free(freed)
 
     def _deps_ready(self, spec: TaskSpec) -> bool:
         return all(

@@ -1,0 +1,126 @@
+"""Distributed reference counting: client-side instance tracking.
+
+Reference: src/ray/core_worker/reference_count.h:61 — every process
+counts the ObjectRef instances it holds; the cluster-level view decides
+when an object's memory can be reclaimed. Centralized variant: each
+client batches its local 0<->1 transitions to the GCS, whose directory
+entry keeps a holder set per object plus pin counts for in-flight task
+dependencies and refs nested inside stored values. An entry whose
+holders drain to empty (having been non-empty) with no pins is freed
+everywhere.
+
+Python refcounting does the heavy lifting: ObjectRef.__init__ calls
+track(), __del__ calls untrack(); only the 0<->1 edges cross the wire,
+batched on a flusher thread.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, Optional, Set
+
+FLUSH_INTERVAL_S = 0.1
+
+_current: Optional["RefTracker"] = None
+
+
+def set_current(tracker: Optional["RefTracker"]) -> None:
+    global _current
+    _current = tracker
+
+
+def track(oid: bytes) -> None:
+    t = _current
+    if t is not None:
+        t.incr(oid)
+
+
+def untrack(oid: bytes) -> None:
+    t = _current
+    if t is not None:
+        t.decr(oid)
+
+
+class RefTracker:
+    def __init__(self, client):
+        # weakref: the tracker thread must not keep a closed client alive.
+        self._client = weakref.ref(client)
+        self._counts: Dict[bytes, int] = {}
+        self._dirty: Set[bytes] = set()
+        self._lock = threading.Lock()
+        self._flusher: Optional[threading.Thread] = None
+        self._stopped = False
+        # oids whose local count hit zero; the client drops lineage for
+        # them at flush time.
+        self._zeroed: Set[bytes] = set()
+
+    def incr(self, oid: bytes) -> None:
+        with self._lock:
+            n = self._counts.get(oid, 0) + 1
+            self._counts[oid] = n
+            if n == 1:
+                self._dirty.add(oid)
+                self._zeroed.discard(oid)
+                self._ensure_flusher()
+
+    def decr(self, oid: bytes) -> None:
+        with self._lock:
+            n = self._counts.get(oid, 0) - 1
+            if n <= 0:
+                self._counts.pop(oid, None)
+                self._dirty.add(oid)
+                self._zeroed.add(oid)
+            else:
+                self._counts[oid] = n
+
+    def holds(self, oid: bytes) -> bool:
+        with self._lock:
+            return self._counts.get(oid, 0) > 0
+
+    def _ensure_flusher(self):
+        if self._flusher is None and not self._stopped:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="ref-flusher", daemon=True
+            )
+            self._flusher.start()
+
+    def _flush_loop(self):
+        import time
+
+        while not self._stopped:
+            time.sleep(FLUSH_INTERVAL_S)
+            client = self._client()
+            if client is None or client.conn.closed:
+                return
+            self.flush(client)
+
+    def flush(self, client) -> None:
+        """Send the net presence change per dirty oid (idempotent set
+        semantics server-side, so transient 1->0->1 flaps are safe)."""
+        with self._lock:
+            if not self._dirty:
+                return
+            dirty, self._dirty = self._dirty, set()
+            add = [oid for oid in dirty if self._counts.get(oid, 0) > 0]
+            remove = [oid for oid in dirty if self._counts.get(oid, 0) <= 0]
+            zeroed, self._zeroed = self._zeroed, set()
+        for oid in zeroed:
+            client._lineage.pop(oid, None)
+        if not add and not remove:
+            return
+        from .protocol import ConnectionLost
+
+        try:
+            client.conn.send(
+                {
+                    "type": "update_refs",
+                    "client": client.worker_id.binary(),
+                    "add": add,
+                    "remove": remove,
+                }
+            )
+        except ConnectionLost:
+            self._stopped = True
+
+    def stop(self):
+        self._stopped = True

@@ -193,9 +193,14 @@ class WorkerRuntime:
                     )
                 )
             else:
+                from ..object_ref import _CaptureRefs
+
                 for i, (oid, v) in enumerate(zip(return_ids, values)):
                     v = serialization.prepare_value(v)
-                    payload, buffers = serialization.dumps(v)
+                    with _CaptureRefs() as cap:
+                        payload, buffers = serialization.dumps(v)
+                    if cap.seen:
+                        results[i]["children"] = cap.seen
                     size = serialization.serialized_size(payload, buffers)
                     if size <= RayConfig.max_inline_object_size:
                         blob = bytearray(size)

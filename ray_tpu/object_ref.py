@@ -3,20 +3,52 @@
 Reference: the C++ ObjectID + Python ObjectRef (python/ray/includes/
 object_ref.pxi). Refs are picklable; passing a ref inside a task arg or
 return value keeps naming the same object (the reference calls this
-borrowing — reference_count.h:61). Round-1 lifetime model: objects live
-for the session (directory-driven free instead of distributed refcount).
+borrowing — reference_count.h:61). Lifetime: every live instance counts
+toward the process's local refcount (ref_tracker.py); when the last
+instance across all clients dies, the GCS directory frees the object.
 """
 from __future__ import annotations
 
+import threading
+
+from ._private import ref_tracker
 from ._private.ids import ObjectID
+
+# Active capture lists (serialization.dumps collects the refs nested in
+# a value being stored, so the directory can pin them as children —
+# the borrowing protocol's "refs inside objects" case).
+_capture = threading.local()
+
+
+class _CaptureRefs:
+    """Context manager collecting ObjectRefs pickled within its scope."""
+
+    def __enter__(self):
+        self.seen = []
+        stack = getattr(_capture, "stack", None)
+        if stack is None:
+            stack = _capture.stack = []
+        stack.append(self.seen)
+        return self
+
+    def __exit__(self, *exc):
+        _capture.stack.pop()
+        return False
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner")
+    __slots__ = ("_id", "_owner", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner: bytes = b""):
         self._id = object_id
         self._owner = owner
+        ref_tracker.track(object_id.binary())
+
+    def __del__(self):
+        try:
+            ref_tracker.untrack(self._id.binary())
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
     def id(self) -> ObjectID:
         return self._id
@@ -37,6 +69,9 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
+        stack = getattr(_capture, "stack", None)
+        if stack:
+            stack[-1].append(self._id.binary())
         return (ObjectRef, (self._id, self._owner))
 
     def future(self):
