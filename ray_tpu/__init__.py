@@ -38,7 +38,7 @@ from ._private.worker import (  # noqa: F401
 )
 from ._private.state import timeline  # noqa: F401
 from .actor import ActorClass, ActorHandle  # noqa: F401
-from .object_ref import ObjectRef  # noqa: F401
+from .object_ref import ObjectRef, ObjectRefGenerator  # noqa: F401
 from .remote_function import RemoteFunction  # noqa: F401
 from . import exceptions  # noqa: F401
 

@@ -16,7 +16,7 @@ from . import serialization
 from .config import RayConfig
 from .ids import ObjectID, WorkerID
 from .object_store import ObjectStore
-from .protocol import ConnectionLost, PeerConn
+from .protocol import OP_CALL, ConnectionLost, PeerConn
 from .task_spec import TaskSpec
 from ..exceptions import GetTimeoutError, RayTaskError, RayTpuError
 from ..object_ref import ObjectRef
@@ -24,6 +24,7 @@ from ..object_ref import ObjectRef
 _MISSING = object()  # direct-route state: never looked up
 _LEASE_PIPELINE_MAX = 16  # max in-flight tasks per leased worker
 _LEASE_IDLE_RETURN_S = 0.5  # idle leases are given back after this
+_FLUSH_INTERVAL_S = 0.002  # safety flush for lazily-buffered sends
 
 
 class CoreClient:
@@ -95,6 +96,37 @@ class CoreClient:
         self._lineage: Dict[bytes, TaskSpec] = {}
         self._tracker = RefTracker(self)
         set_current(self._tracker)
+        # Lazily-buffered connections (hot-path frames coalesce into one
+        # wire message per burst); flushed before any blocking get/wait
+        # and by a safety timer for fire-and-forget callers.
+        self._lazy_conns: set = set()
+        self._lazy_flusher: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------- lazy flushing
+
+    def _mark_lazy(self, conn: PeerConn) -> None:
+        self._lazy_conns.add(conn)
+        if self._lazy_flusher is None:
+            self._lazy_flusher = threading.Thread(
+                target=self._lazy_flush_loop, name="lazy-flusher", daemon=True
+            )
+            self._lazy_flusher.start()
+
+    def flush_lazy(self) -> None:
+        for c in list(self._lazy_conns):
+            if c.closed:
+                self._lazy_conns.discard(c)
+                continue
+            if c.has_buffered:
+                try:
+                    c.flush()
+                except ConnectionLost:
+                    self._lazy_conns.discard(c)
+
+    def _lazy_flush_loop(self) -> None:
+        while not self.conn.closed:
+            time.sleep(_FLUSH_INTERVAL_S)
+            self.flush_lazy()
 
     def _on_push(self, msg: Dict[str, Any]):
         self._push_handler(msg)
@@ -222,46 +254,50 @@ class CoreClient:
         return lease
 
     def _push_leased(self, lease, spec: TaskSpec) -> List[ObjectRef]:
-        """Caller must have already claimed a slot (outstanding += 1)."""
-        from concurrent.futures import Future
+        """Caller must have already claimed a slot (outstanding += 1).
 
-        self._record_lineage(spec)
-        oids = [oid.binary() for oid in spec.return_object_ids()]
-        with self._lease_lock:
-            for ob in oids:
-                self._direct_results[ob] = Future()
+        Ships a compact OP_CALL frame, buffered (send_lazy): a burst of
+        submissions coalesces into one wire message, and the reply
+        future doubles as the per-return result slot — get() interprets
+        the reply frame lazily, so the steady-state task costs one
+        Future and one tuple pickle end to end."""
+        conn: PeerConn = lease["conn"]
+        tid = spec.task_id._bytes
+        nret = spec.num_returns
+        oids = [ObjectID.bytes_for_return(tid, i) for i in range(nret)]
+        lineage = self._lineage
+        for ob in oids:
+            lineage[ob] = spec
+        req_id = conn.next_req_id()
+        rfut = conn.register_future(req_id)
+        for i, ob in enumerate(oids):
+            self._direct_results[ob] = (rfut, i)
+        frame = (
+            OP_CALL, req_id, tid, spec.function_id, None, spec.args_blob,
+            nret, None,
+        )
+        owner = self.worker_id.binary()
+        refs = [ObjectRef(ObjectID(ob), owner) for ob in oids]
         try:
-            rfut = lease["conn"].request_async(
-                {"type": "execute_task", "spec": spec}
-            )
-        except BaseException:
+            conn.send_lazy(frame)
+        except ConnectionLost:
+            conn.drop_future(req_id)
             # Send failed: the task never reached the worker, so a GCS
             # resubmit is always safe.
             self._leased_conn_lost(lease, spec, oids, delivered=False)
-            return self._refs_for(spec)
+            return refs
+        self._mark_lazy(conn)
         rfut.add_done_callback(
             lambda f, lease=lease, spec=spec, oids=oids: self._resolve_leased(
                 lease, spec, oids, f
             )
         )
-        return self._refs_for(spec)
+        return refs
 
     def _resolve_leased(self, lease, spec: TaskSpec, oids, rfut):
-        try:
-            reply = rfut.result()
-        except BaseException:  # noqa: BLE001 - conn lost after delivery
+        if rfut.exception() is not None:
             self._leased_conn_lost(lease, spec, oids, delivered=True)
             return
-        for i, ob in enumerate(oids):
-            f = self._direct_results.get(ob)
-            if f is None or f.done():
-                continue
-            if reply.get("error") is not None:
-                f.set_result({"status": "FAILED", "error": reply["error"]})
-            else:
-                fields = dict(reply["results"][i])
-                fields["status"] = "READY"
-                f.set_result(fields)
         self._dec_lease(lease)
 
     def _leased_conn_lost(self, lease, spec: TaskSpec, oids, delivered: bool):
@@ -293,16 +329,12 @@ class CoreClient:
                 WorkerCrashedError("leased worker connection lost mid-task")
             )
             for ob in oids:
-                f = self._direct_results.pop(ob, None)
-                if f is not None and not f.done():
-                    f.set_result({"status": "FAILED", "error": blob})
+                self._direct_results[ob] = {"status": "FAILED", "error": blob}
             return
         if delivered:
             spec.max_retries -= 1
         for ob in oids:
-            f = self._direct_results.pop(ob, None)
-            if f is not None and not f.done():
-                f.set_result({"via_gcs": True})
+            self._direct_results[ob] = {"via_gcs": True}
         try:
             self.conn.send({"type": "submit_task", "spec": spec})
         except ConnectionLost:
@@ -353,6 +385,77 @@ class CoreClient:
                     return
 
     # ----------------------------------------------------- direct actor path
+
+    def call_actor_fast(
+        self,
+        aid: bytes,
+        method_name: str,
+        args_blob: bytes,
+        num_returns: int,
+        deps: Sequence[ObjectID] = (),
+    ) -> Optional[List[ObjectRef]]:
+        """Steady-state actor call: compact frame straight down an
+        established direct connection, no TaskSpec object at all.
+        Returns None when the route isn't live yet (first call,
+        resolving, or GCS-routed actor) — the caller falls back to the
+        TaskSpec path which establishes/buffers correctly."""
+        conn = self._direct_conns.get(aid)
+        if conn is None or conn == "resolving" or isinstance(conn, str):
+            return None
+        import os as _os
+
+        tid = _os.urandom(16)
+        return self._send_frame(
+            conn, aid, tid, method_name, args_blob, num_returns, deps
+        )
+
+    def _send_frame(
+        self, conn, aid: bytes, tid: bytes, method_name: str,
+        args_blob: bytes, num_returns: int, deps: Sequence[ObjectID] = (),
+    ) -> List[ObjectRef]:
+        oids = [
+            ObjectID.bytes_for_return(tid, i) for i in range(num_returns)
+        ]
+        req_id = conn.next_req_id()
+        rfut = conn.register_future(req_id)
+        with self._direct_lock:
+            pending = self._direct_oids.setdefault(aid, set())
+            for i, ob in enumerate(oids):
+                self._direct_results[ob] = (rfut, i)
+                pending.add(ob)
+        # Pin arg refs for the life of the in-flight call. The GCS route
+        # pins spec.dependencies server-side (_h_submit_task task_pins);
+        # the direct route bypasses the GCS, so without this the caller
+        # dropping its own ref (e.g. re-broadcasting weights every step
+        # while calls queue behind a deep actor backlog) frees the
+        # object before the actor's arg-resolution get — which then
+        # parks forever and wedges the serial actor.
+        dep_ids = [d.binary() for d in deps]
+        for d in dep_ids:
+            self._tracker.incr(d)
+        frame = (
+            OP_CALL, req_id, tid, None, method_name, args_blob, num_returns, aid,
+        )
+        owner = self.worker_id.binary()
+        refs = [ObjectRef(ObjectID(ob), owner) for ob in oids]
+        try:
+            conn.send_lazy(frame)
+        except ConnectionLost:
+            conn.drop_future(req_id)
+            for d in dep_ids:
+                self._tracker.decr(d)
+            self._on_direct_close(aid)
+            return refs
+        self._mark_lazy(conn)
+
+        def _resolved(f, oids=oids, aid=aid, dep_ids=dep_ids):
+            for d in dep_ids:
+                self._tracker.decr(d)
+            self._resolve_direct(aid, oids, f)
+
+        rfut.add_done_callback(_resolved)
+        return refs
+
     def submit_actor_direct(self, spec: TaskSpec) -> Optional[List[ObjectRef]]:
         """Submit an actor method over the direct transport.
 
@@ -421,50 +524,26 @@ class CoreClient:
             self._direct_conns[aid] = conn
 
     def _send_direct(self, conn, spec: TaskSpec) -> Optional[List[ObjectRef]]:
-        from concurrent.futures import Future
-
-        aid = spec.actor_id.binary()
-        oids = [oid.binary() for oid in spec.return_object_ids()]
-        with self._direct_lock:
-            pending = self._direct_oids.setdefault(aid, set())
-            for ob in oids:
-                f: Future = Future()
-                self._direct_results[ob] = f
-                pending.add(ob)
-        try:
-            rfut = conn.request_async({"type": "execute_task", "spec": spec})
-        except BaseException:
-            self._on_direct_close(aid)
-            return self._refs_for(spec)  # futures fail via _on_direct_close
-        rfut.add_done_callback(
-            lambda f, oids=oids, aid=aid: self._resolve_direct(aid, oids, f)
+        return self._send_frame(
+            conn,
+            spec.actor_id.binary(),
+            spec.task_id._bytes,
+            spec.method_name,
+            spec.args_blob,
+            spec.num_returns,
+            spec.dependencies,
         )
-        return self._refs_for(spec)
 
     def _resolve_direct(self, aid: bytes, oids, rfut) -> None:
-        from ..exceptions import ActorDiedError
-
-        try:
-            reply = rfut.result()
-        except BaseException:
-            reply = None
+        if rfut.exception() is not None:
+            # Conn lost mid-flight: _on_direct_close (triggered by the
+            # reader teardown) marks every pending oid as actor-died.
+            self._on_direct_close(aid)
+            return
         with self._direct_lock:
-            pending = self._direct_oids.get(aid, set())
-            futs = [
-                (ob, self._direct_results.get(ob)) for ob in oids
-            ]
-            pending.difference_update(oids)
-        for i, (ob, f) in enumerate(futs):
-            if f is None or f.done():
-                continue
-            if reply is None:
-                f.set_exception(ActorDiedError(reason="connection lost"))
-            elif reply.get("error") is not None:
-                f.set_result({"status": "FAILED", "error": reply["error"]})
-            else:
-                fields = dict(reply["results"][i])
-                fields["status"] = "READY"
-                f.set_result(fields)
+            pending = self._direct_oids.get(aid)
+            if pending is not None:
+                pending.difference_update(oids)
 
     def _on_direct_close(self, aid: bytes) -> None:
         from ..exceptions import ActorDiedError
@@ -472,10 +551,13 @@ class CoreClient:
         with self._direct_lock:
             self._direct_conns[aid] = None
             pending = self._direct_oids.pop(aid, set())
-            futs = [self._direct_results.get(ob) for ob in pending]
-        for f in futs:
-            if f is not None and not f.done():
-                f.set_exception(ActorDiedError(reason="actor connection lost"))
+            for ob in pending:
+                if self._direct_results.pop(ob, None) is not None:
+                    self._direct_results[ob] = {
+                        "exception": ActorDiedError(
+                            reason="actor connection lost"
+                        )
+                    }
 
     # ------------------------------------------------------------------ objects
 
@@ -569,18 +651,66 @@ class CoreClient:
                 )
         return self._materialize(reply, oid)
 
+    def _resolve_direct_entry(
+        self, ref: ObjectRef, entry, remaining: Optional[float]
+    ) -> Dict[str, Any]:
+        """Turn a _direct_results entry — (reply_future, index) or an
+        already-resolved fields dict — into result fields, consuming it."""
+        idb = ref.id().binary()
+        if type(entry) is tuple:
+            rfut, idx = entry
+            try:
+                reply = rfut.result(timeout=remaining)
+            except TimeoutError:
+                raise GetTimeoutError(f"get timed out on {ref}") from None
+            except BaseException:
+                # Connection lost: the failure callback rewrites the
+                # entry with the outcome (resubmitted via GCS, failed,
+                # actor died). Callbacks run just after waiters wake —
+                # spin briefly for the rewrite.
+                stop = time.monotonic() + 2.0
+                while True:
+                    e2 = self._direct_results.get(idb)
+                    if isinstance(e2, dict):
+                        entry = e2
+                        break
+                    if time.monotonic() > stop:
+                        raise
+                    time.sleep(0.001)
+            else:
+                # Consumed: later gets resolve through the GCS directory
+                # (the worker's batched task_done seals results there).
+                self._direct_results.pop(idb, None)
+                if reply[2] is not None:
+                    return {"status": "FAILED", "error": reply[2]}
+                r = reply[3][idx]
+                return {
+                    "status": "READY",
+                    "inline": r[0],
+                    "segment": r[1],
+                    "size": r[2],
+                }
+        # Sentinel dicts stay in place: the GCS never saw these tasks,
+        # so a repeat get must find the sentinel again (popping it would
+        # strand the second get on a directory entry that never seals).
+        exc = entry.get("exception")
+        if exc is not None:
+            raise exc
+        return entry
+
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
+        self.flush_lazy()
         # Pipeline: fire every get_object request up front, then collect —
         # a batch of N costs one round-trip of latency, not N (reference:
         # the core worker batches plasma fetches in Get, core_worker.cc).
         futs = []
         for ref in refs:
-            fut = self._direct_results.get(ref.id().binary())
-            if fut is not None:
-                # Direct actor-call result: resolves on the direct socket,
+            entry = self._direct_results.get(ref.id().binary())
+            if entry is not None:
+                # Direct call result: resolves on the direct socket,
                 # no GCS round-trip.
-                futs.append((ref, fut, True))
+                futs.append((ref, entry, True))
             else:
                 futs.append(
                     (
@@ -592,32 +722,42 @@ class CoreClient:
                     )
                 )
         out = []
-        for ref, fut, direct in futs:
+        for ref, ent, direct in futs:
             remaining = None
             if deadline is not None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise GetTimeoutError(f"get timed out on {ref}")
-            try:
-                reply = fut.result(timeout=remaining)
-            except TimeoutError:
-                raise GetTimeoutError(f"get timed out on {ref}") from None
             if direct:
-                # Consumed: later gets resolve through the GCS directory
-                # (the worker's async task_done seals results there), so
-                # holding the Future would only leak the inline payload.
-                self._direct_results.pop(ref.id().binary(), None)
-            if direct and reply.get("inline") is None and reply.get("status") != "FAILED":
-                oid = ref.id()
-                if not self.store.contains(oid):
-                    # Large direct result on another node's store — or a
-                    # via-GCS sentinel: the reply has no location info.
-                    reply = self.conn.request(
-                        {"type": "get_object", "object_id": oid.binary()},
-                        timeout=remaining,
-                    )
-            out.append(self._materialize_or_reconstruct(reply, ref, remaining))
+                fields = self._resolve_direct_entry(ref, ent, remaining)
+            else:
+                try:
+                    fields = ent.result(timeout=remaining)
+                except TimeoutError:
+                    raise GetTimeoutError(f"get timed out on {ref}") from None
+            if direct and (
+                fields.get("via_gcs")
+                or (
+                    fields.get("inline") is None
+                    and fields.get("status") != "FAILED"
+                    and not self.store.contains(ref.id())
+                )
+            ):
+                # Resubmitted via the GCS, or a large result not in the
+                # local store: the directory has (or will have) the
+                # authoritative location.
+                fields = self.conn.request(
+                    {"type": "get_object", "object_id": ref.id().binary()},
+                    timeout=remaining,
+                )
+            out.append(self._materialize_or_reconstruct(fields, ref, remaining))
         return out
+
+    @staticmethod
+    def _entry_done(entry) -> bool:
+        if type(entry) is tuple:
+            return entry[0].done()
+        return True  # resolved sentinel dict
 
     def wait(
         self,
@@ -627,14 +767,16 @@ class CoreClient:
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         ids = [r.id().binary() for r in refs]
         deadline = None if timeout is None else time.monotonic() + timeout
+        self.flush_lazy()
         while True:
-            # Direct actor-call results resolve on the direct socket; the
-            # GCS only learns of them via the worker's async task_done —
-            # count locally-done futures as ready immediately.
+            # Direct call results resolve on the direct socket; the
+            # GCS only learns of them via the worker's batched task_done —
+            # count locally-done entries as ready immediately.
             direct_ready = {
                 oid
                 for oid in ids
-                if (f := self._direct_results.get(oid)) is not None and f.done()
+                if (f := self._direct_results.get(oid)) is not None
+                and self._entry_done(f)
             }
             has_direct_pending = any(
                 oid in self._direct_results and oid not in direct_ready

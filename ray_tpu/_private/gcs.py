@@ -197,6 +197,11 @@ class GcsServer:
         # (reference: GcsTaskManager task-event store,
         # gcs_task_manager.h:85). Bounded: oldest events roll off.
         self.task_events: deque = deque(maxlen=100_000)
+        # Streaming-generator state per task (reference: streaming
+        # return handling, task_manager.h:208): item count as the
+        # executor seals yields, total+error once the generator ends,
+        # parked stream_next requests awaiting the next item.
+        self.streams: Dict[bytes, Dict[str, Any]] = {}
         self._store = ObjectStore()
         self._peers: List[PeerConn] = []
         self._shutdown = False
@@ -479,76 +484,183 @@ class GcsServer:
             w.inflight.pop(spec.task_id.binary(), None)
             actor.pending.append(spec)
 
-    def _h_task_done(self, state, msg):
+    # ------------------------------------------------- streaming generators
+
+    def _stream_state(self, task_id: bytes) -> Dict[str, Any]:
+        st = self.streams.get(task_id)
+        if st is None:
+            st = self.streams[task_id] = {
+                "count": 0, "total": None, "error": None, "waiters": [],
+            }
+        return st
+
+    def _stream_notify(self, st: Dict[str, Any]) -> None:
+        """Answer parked stream_next requests that can now resolve.
+        Caller holds self._lock."""
+        still_waiting = []
+        for peer, req_id, index in st["waiters"]:
+            if index < st["count"]:
+                reply = {"type": "reply", "req_id": req_id, "ok": True,
+                         "available": True}
+            elif st["total"] is not None:
+                reply = {"type": "reply", "req_id": req_id, "ok": True,
+                         "ended": True, "total": st["total"],
+                         "error": st["error"]}
+            else:
+                still_waiting.append((peer, req_id, index))
+                continue
+            try:
+                peer.send(reply)
+            except ConnectionLost:
+                pass
+        st["waiters"] = still_waiting
+
+    def _h_stream_item(self, state, msg):
+        """One yield from a streaming task: seal it as its own object
+        and wake consumers parked on its index."""
         wid = msg["worker_id"]
-        results = msg["results"]  # list of dicts per return
-        error_blob = msg.get("error")
-        freed: List[bytes] = []
         with self._lock:
             w = self.workers.get(wid)
-            task_id = msg["task_id"]
-            spec: Optional[TaskSpec] = w.inflight.get(task_id) if w else None
-            self._record_task_event(
+            r = msg["result"]
+            entry = self.objects.setdefault(r["object_id"], ObjectEntry())
+            entry.status = READY
+            entry.inline = r.get("inline")
+            entry.segment = r.get("segment")
+            entry.size = r.get("size", 0)
+            entry.node_id = w.node_id if w else None
+            for child in r.get("children", []):
+                entry.children.append(child)
+                self.objects.setdefault(child, ObjectEntry()).child_pins += 1
+            self._notify_object(entry)
+            st = self._stream_state(msg["task_id"])
+            st["count"] = max(st["count"], msg["index"] + 1)
+            self._stream_notify(st)
+
+    def _h_stream_next(self, state, msg):
+        peer: PeerConn = state["peer"]
+        task_id = msg["task_id"]
+        index = msg["index"]
+        with self._lock:
+            st = self._stream_state(task_id)
+            if index < st["count"]:
+                peer.reply(msg, ok=True, available=True)
+                return
+            if st["total"] is not None:
+                peer.reply(
+                    msg, ok=True, ended=True, total=st["total"],
+                    error=st["error"],
+                )
+                # Consumer walked past the end: drop the stream state
+                # (unbounded growth otherwise — one entry per serve
+                # request). A generator is single-consumer and never
+                # rewinds, so nothing re-asks after this.
+                if index >= st["total"] and not st["waiters"]:
+                    self.streams.pop(task_id, None)
+                return
+            st["waiters"].append((peer, msg["req_id"], index))
+
+    def _end_stream(self, task_id: bytes, total: int,
+                    error_blob: Optional[bytes]) -> None:
+        """Caller holds self._lock."""
+        st = self._stream_state(task_id)
+        st["total"] = max(total, st["count"])
+        st["error"] = error_blob
+        self._stream_notify(st)
+
+    def _h_task_done(self, state, msg):
+        freed: List[bytes] = []
+        with self._lock:
+            self._apply_task_done(msg["worker_id"], msg, freed)
+            self._work.notify_all()
+        self._broadcast_free(freed)
+
+    def _h_task_done_batch(self, state, msg):
+        """Coalesced direct-path completions (one message per worker per
+        flush interval instead of one per call — the GCS lives in the
+        driver process, so per-call handling steals driver GIL time at
+        the aggregate cluster call rate)."""
+        wid = msg["worker_id"]
+        freed: List[bytes] = []
+        with self._lock:
+            for item in msg["items"]:
+                self._apply_task_done(wid, item, freed)
+            self._work.notify_all()
+        self._broadcast_free(freed)
+
+    def _apply_task_done(self, wid: bytes, msg: Dict[str, Any],
+                         freed: List[bytes]) -> None:
+        """Apply one completion record. Caller holds self._lock."""
+        results = msg["results"]  # list of dicts per return
+        error_blob = msg.get("error")
+        w = self.workers.get(wid)
+        task_id = msg["task_id"]
+        spec: Optional[TaskSpec] = w.inflight.pop(task_id, None) if w else None
+        self.task_events.append(
+            (
                 task_id,
                 spec.name if spec else msg.get("name", "?"),
                 "FAILED" if error_blob is not None else "FINISHED",
+                time.time(),
                 wid,
             )
-            if w is not None:
-                w.inflight.pop(task_id, None)
-                if w.state == W_BUSY:
-                    w.state = W_ACTOR if w.actor_id is not None else W_IDLE
-                    if w.current_task is not None:
-                        # Actors hold their creation resources for their
-                        # lifetime (released on death), unless creation failed.
-                        if not w.current_task.actor_creation or error_blob is not None:
-                            self._release_task_resources(w.current_task, w.node_id)
-                    w.current_task = None
-            # Application-level retry (reference: TaskManager::RetryTaskIfPossible
-            # task_manager.h:468 — app errors retry only with retry_exceptions).
-            if (
-                error_blob is not None
-                and spec is not None
-                and not spec.actor_creation
-                and spec.actor_id is None
-                and spec.retry_exceptions
-                and spec.max_retries > 0
-            ):
-                spec.max_retries -= 1
-                self._pending.append(spec)
-                self._work.notify_all()
-                return
-            for r in results:
-                entry = self.objects.setdefault(r["object_id"], ObjectEntry())
-                if error_blob is not None:
-                    entry.status = FAILED
-                    entry.error = error_blob
-                else:
-                    entry.status = READY
-                    entry.inline = r.get("inline")
-                    entry.segment = r.get("segment")
-                    entry.size = r.get("size", 0)
-                    entry.node_id = w.node_id if w else None
-                    for child in r.get("children", []):
-                        entry.children.append(child)
-                        self.objects.setdefault(
-                            child, ObjectEntry()
-                        ).child_pins += 1
-                self._notify_object(entry)
-                # Refs already dropped before the result sealed: reclaim.
-                self._maybe_free(r["object_id"], entry, freed)
-            # Task terminal: release its dependency pins.
-            if spec is not None:
-                for dep in spec.dependencies:
-                    de = self.objects.get(dep.binary())
-                    if de is not None:
-                        de.task_pins = max(0, de.task_pins - 1)
-                        self._maybe_free(dep.binary(), de, freed)
-            if msg.get("actor_creation"):
-                self._on_actor_created(msg["actor_id"], wid, ok=error_blob is None,
-                                       error_blob=error_blob)
-            self._work.notify_all()
-        self._broadcast_free(freed)
+        )
+        if w is not None:
+            if w.state == W_BUSY:
+                w.state = W_ACTOR if w.actor_id is not None else W_IDLE
+                if w.current_task is not None:
+                    # Actors hold their creation resources for their
+                    # lifetime (released on death), unless creation failed.
+                    if not w.current_task.actor_creation or error_blob is not None:
+                        self._release_task_resources(w.current_task, w.node_id)
+                w.current_task = None
+        total = msg.get("streaming_total")
+        if total is not None:
+            self._end_stream(task_id, total, error_blob)
+        # Application-level retry (reference: TaskManager::RetryTaskIfPossible
+        # task_manager.h:468 — app errors retry only with retry_exceptions).
+        # Streaming tasks never retry: items already consumed can't be
+        # un-yielded.
+        if (
+            error_blob is not None
+            and spec is not None
+            and not spec.actor_creation
+            and spec.actor_id is None
+            and spec.retry_exceptions
+            and spec.max_retries > 0
+            and total is None
+        ):
+            spec.max_retries -= 1
+            self._pending.append(spec)
+            return
+        for r in results:
+            entry = self.objects.setdefault(r["object_id"], ObjectEntry())
+            if error_blob is not None:
+                entry.status = FAILED
+                entry.error = error_blob
+            else:
+                entry.status = READY
+                entry.inline = r.get("inline")
+                entry.segment = r.get("segment")
+                entry.size = r.get("size", 0)
+                entry.node_id = w.node_id if w else None
+                for child in r.get("children", []):
+                    entry.children.append(child)
+                    self.objects.setdefault(
+                        child, ObjectEntry()
+                    ).child_pins += 1
+            self._notify_object(entry)
+            # Refs already dropped before the result sealed: reclaim.
+            self._maybe_free(r["object_id"], entry, freed)
+        # Task terminal: release its dependency pins.
+        if spec is not None:
+            for dep in spec.dependencies:
+                de = self.objects.get(dep.binary())
+                if de is not None:
+                    de.task_pins = max(0, de.task_pins - 1)
+                    self._maybe_free(dep.binary(), de, freed)
+        if msg.get("actor_creation"):
+            self._on_actor_created(msg["actor_id"], wid, ok=error_blob is None,
+                                   error_blob=error_blob)
 
     def _on_actor_created(self, aid: bytes, wid: bytes, ok: bool, error_blob=None):
         actor = self.actors.get(aid)
@@ -962,9 +1074,11 @@ class GcsServer:
             self._fail_task_returns(actor.pending.popleft(), None, actor_error=reason)
         self._notify_direct_waiters(actor)
         if actor.worker_id is not None:
-            w = self.workers.get(actor.worker_id.binary())
+            wid = actor.worker_id.binary()
+            w = self.workers.get(wid)
             if w is not None and w.state != W_DEAD:
-                w.state = W_DEAD
+                # Creation-lifetime resources: the death handler's actor
+                # branch skips them for already-A_DEAD actors.
                 self._release_task_resources(actor.spec, w.node_id)
                 if w.conn is not None:
                     try:
@@ -972,9 +1086,22 @@ class GcsServer:
                     except ConnectionLost:
                         pass
                 if w.proc is not None:
-                    threading.Thread(
-                        target=_reap, args=(w.proc,), daemon=True
-                    ).start()
+                    # Force-kill semantics (reference: ray.kill is
+                    # SIGKILL, no graceful drain): without this the
+                    # worker keeps serving direct-transport calls until
+                    # it notices the polite exit, and a call racing the
+                    # kill can still succeed.
+                    try:
+                        w.proc.kill()
+                    except Exception:  # noqa: BLE001
+                        pass
+                # Full worker teardown — fails the worker's in-flight
+                # GCS-routed tasks (callers would otherwise park on
+                # their returns forever), releases lease resources,
+                # drops it from the node pool, reaps the process. The
+                # actor is already A_DEAD above, so no restart is
+                # attempted.
+                self._handle_worker_death(wid, f"actor killed: {reason}")
 
     def _h_actor_exit(self, state, msg):
         # Graceful self-exit (__ray_terminate__).
@@ -1388,6 +1515,11 @@ class GcsServer:
             entry.status = FAILED
             entry.error = error_blob
             self._notify_object(entry)
+        if spec.num_returns == -1:
+            # Streaming task failed outside the worker: end the stream
+            # so parked consumers see the error instead of hanging.
+            st = self._stream_state(spec.task_id.binary())
+            self._end_stream(spec.task_id.binary(), st["count"], error_blob)
         # Terminal: release dependency pins.
         freed: List[bytes] = []
         for dep in spec.dependencies:
@@ -1625,8 +1757,9 @@ class GcsServer:
             node = self.nodes.get(w.node_id.binary())
             if node is not None:
                 node.pool.discard(wid)
-            if w.current_task is not None:
-                self._release_task_resources(w.current_task, w.node_id)
+            dying_task = w.current_task
+            if dying_task is not None:
+                self._release_task_resources(dying_task, w.node_id)
                 w.current_task = None
             if w.lease_resources:
                 if node is not None:
@@ -1650,8 +1783,17 @@ class GcsServer:
             if w.actor_id is not None:
                 actor = self.actors.get(w.actor_id.binary())
                 if actor is not None and actor.state not in (A_DEAD, A_RESTARTING):
-                    if prev_state == W_ACTOR:
-                        # Lifetime resources held since creation.
+                    released_creation = (
+                        dying_task is not None and dying_task.actor_creation
+                    )
+                    if prev_state == W_ACTOR or (
+                        prev_state == W_BUSY and not released_creation
+                    ):
+                        # Lifetime resources held since creation. W_BUSY
+                        # mid-method: the method's own resources went via
+                        # current_task above, creation's release here.
+                        # W_BUSY mid-creation: current_task IS the
+                        # creation spec — already released, don't double.
                         self._release_task_resources(actor.spec, w.node_id)
                     if actor.restarts_used < actor.spec.max_restarts:
                         # Restart state machine (reference: GcsActorManager,

@@ -60,14 +60,19 @@ class BaseID:
 class ObjectID(BaseID):
     @classmethod
     def for_task_return(cls, task_id: "TaskID", index: int) -> "ObjectID":
-        # Deterministic: hash of task id + return index (reference packs the
-        # return index into the id; we hash for uniform layout).
-        import hashlib
+        # Deterministic: 12 random bytes of the task id + the return
+        # index (the reference packs the index into the id the same way
+        # — id.h ObjectID::ForTaskReturn). Runs on the submit hot path,
+        # so no hashing: task ids are random, 96 bits of prefix is
+        # collision-proof at any realistic task count.
+        oid = cls.__new__(cls)
+        oid._bytes = task_id._bytes[:12] + index.to_bytes(4, "little")
+        return oid
 
-        h = hashlib.blake2b(
-            task_id.binary() + index.to_bytes(4, "little"), digest_size=ID_LENGTH
-        )
-        return cls(h.digest())
+    @staticmethod
+    def bytes_for_return(task_id_bytes: bytes, index: int) -> bytes:
+        """Raw-bytes variant for wire-frame paths that skip ID objects."""
+        return task_id_bytes[:12] + index.to_bytes(4, "little")
 
 
 class TaskID(BaseID):

@@ -2,12 +2,25 @@
 
 The reference's control plane is gRPC (src/ray/rpc/) with one service per
 daemon. On-node we use unix-domain sockets via multiprocessing.connection
-(length-prefixed pickle frames) — the same request/reply + push pattern,
-without a schema compiler. A ``PeerConn`` wraps a Connection with a send
-lock, a reader thread, request/reply correlation futures, and a handler
-for unsolicited pushes (the pubsub direction).
+(length-prefixed frames) — the same request/reply + push pattern, without
+a schema compiler. A ``PeerConn`` wraps a Connection with a send lock, a
+reader thread, request/reply correlation futures, and a handler for
+unsolicited pushes (the pubsub direction).
 
-Message = dict with a "type" key. Replies carry the originating "req_id".
+Two message shapes share each connection:
+
+- dicts with a "type" key: the general control plane (replies carry the
+  originating "req_id").
+- tuples: compact frames for the two hot paths — task/actor-call
+  execution and its reply. A tuple costs a fraction of a dict to pickle
+  and carries no field-name strings (reference: the hot RPCs are
+  hand-rolled protobufs while the long tail shares generic plumbing).
+
+Senders may coalesce: ``send_lazy`` buffers frames and ships them as one
+``("B", [...])`` envelope — one pickle header + one syscall for a whole
+burst. This is the single biggest control-plane cost lever: every
+message otherwise pays its own pickle + write + reader wakeup
+(reference: gRPC channel-level batching / writev).
 """
 from __future__ import annotations
 
@@ -15,7 +28,13 @@ import itertools
 import threading
 from concurrent.futures import Future
 from multiprocessing.connection import Connection
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+# Tuple-frame opcodes.
+OP_CALL = 1  # (1, req_id, task_id, function_id, method, args_blob, num_returns, actor_id)
+OP_REPLY = 2  # (2, req_id, error_blob, results); results = [(inline, segment, size, children)]
+
+_LAZY_MAX = 128  # flush the out-buffer at this depth regardless
 
 
 class ConnectionLost(Exception):
@@ -28,13 +47,14 @@ class PeerConn:
     def __init__(
         self,
         conn: Connection,
-        push_handler: Callable[[Dict[str, Any]], None],
+        push_handler: Callable[[Any], None],
         on_close: Optional[Callable[[], None]] = None,
         name: str = "peer",
         autostart: bool = True,
     ):
         self._conn = conn
         self._send_lock = threading.Lock()
+        self._out: List[Any] = []
         self._pending: Dict[int, Future] = {}
         self._pending_lock = threading.Lock()
         self._req_counter = itertools.count()
@@ -52,18 +72,70 @@ class PeerConn:
         if not self._reader.is_alive():
             self._reader.start()
 
-    def send(self, msg: Dict[str, Any]) -> None:
-        """Fire-and-forget push."""
+    # ------------------------------------------------------------------ send
+
+    def send(self, msg: Any) -> None:
+        """Eager push: flushes anything buffered first (order preserved)."""
         with self._send_lock:
-            try:
-                self._conn.send(msg)
-            except (OSError, EOFError, BrokenPipeError) as e:
-                raise ConnectionLost(str(e)) from e
+            self._out.append(msg)
+            self._flush_locked()
+
+    def send_lazy(self, msg: Any) -> None:
+        """Buffered push: shipped on the next flush/eager send, or when
+        the buffer hits the depth cap. Callers that buffer are
+        responsible for flushing before they block on a reply."""
+        with self._send_lock:
+            self._out.append(msg)
+            if len(self._out) >= _LAZY_MAX:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        if not self._out:
+            return
+        with self._send_lock:
+            self._flush_locked()
+
+    @property
+    def has_buffered(self) -> bool:
+        return bool(self._out)
+
+    def _flush_locked(self) -> None:
+        out = self._out
+        if not out:
+            return
+        self._out = []
+        try:
+            if len(out) == 1:
+                self._conn.send(out[0])
+            else:
+                self._conn.send(("B", out))
+        except (OSError, EOFError, BrokenPipeError, ValueError) as e:
+            raise ConnectionLost(str(e)) from e
+
+    # -------------------------------------------------------------- request
+
+    def next_req_id(self) -> int:
+        return next(self._req_counter)
+
+    def register_future(self, req_id: int) -> Future:
+        """Register a reply future for a frame the caller sends itself
+        (compact tuple frames carry the req_id in-band)."""
+        fut: Future = Future()
+        with self._pending_lock:
+            self._pending[req_id] = fut
+        return fut
+
+    def drop_future(self, req_id: int) -> None:
+        with self._pending_lock:
+            self._pending.pop(req_id, None)
 
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
-        """Send and block for the correlated reply; returns reply dict."""
+        """Send and block for the correlated reply; returns reply dict.
+
+        The req_id is written into ``msg`` in place — callers pass a
+        fresh dict per request (every call site builds a literal)."""
         req_id = next(self._req_counter)
-        msg = dict(msg, req_id=req_id)
+        msg["req_id"] = req_id
         fut: Future = Future()
         with self._pending_lock:
             self._pending[req_id] = fut
@@ -78,7 +150,7 @@ class PeerConn:
         """Fire a request, return the reply Future (for pipelined
         direct actor calls — many in flight on one connection)."""
         req_id = next(self._req_counter)
-        msg = dict(msg, req_id=req_id)
+        msg["req_id"] = req_id
         fut: Future = Future()
         with self._pending_lock:
             self._pending[req_id] = fut
@@ -93,17 +165,40 @@ class PeerConn:
     def reply(self, req_msg: Dict[str, Any], **fields) -> None:
         self.send({"type": "reply", "req_id": req_msg["req_id"], **fields})
 
+    # ---------------------------------------------------------------- receive
+
+    def _deliver(self, msg: Any) -> None:
+        if type(msg) is tuple:
+            op = msg[0]
+            if op == OP_REPLY:
+                with self._pending_lock:
+                    fut = self._pending.pop(msg[1], None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+            elif op == "B":
+                for m in msg[1]:
+                    self._deliver(m)
+            else:
+                self._push_handler(msg)
+        elif msg.get("type") == "reply":
+            with self._pending_lock:
+                fut = self._pending.pop(msg["req_id"], None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+        else:
+            self._push_handler(msg)
+
     def _read_loop(self) -> None:
+        recv = self._conn.recv
         try:
             while True:
-                msg = self._conn.recv()
-                if msg.get("type") == "reply":
-                    with self._pending_lock:
-                        fut = self._pending.pop(msg["req_id"], None)
-                    if fut is not None and not fut.done():
-                        fut.set_result(msg)
-                else:
-                    self._push_handler(msg)
+                msg = recv()
+                self._deliver(msg)
+                # Replies generated inline while draining (worker-side
+                # execution on this thread) ship the moment the input
+                # goes quiet — batch-for-batch with the caller's bursts.
+                if self._out and not self._conn.poll(0):
+                    self.flush()
         except (EOFError, OSError, BrokenPipeError):
             pass
         except TypeError:

@@ -32,10 +32,27 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
+# Types plain pickle round-trips identically to cloudpickle (no code
+# objects, no __main__-defined classes to ship by value). Plain pickle
+# is ~7x faster on these, and they dominate hot-path payloads.
+_PLAIN_TYPES = frozenset(
+    (bytes, bytearray, str, int, float, bool, type(None))
+)
+
+
 def dumps(value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
     """Serialize to (header+pickle bytes, out-of-band buffers)."""
     buffers: List[pickle.PickleBuffer] = []
-    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    t = type(value)
+    if t in _PLAIN_TYPES or (
+        t.__module__ == "numpy" and t.__name__ == "ndarray"
+        and value.dtype.hasobject is False
+    ):
+        payload = pickle.dumps(value, 5, buffer_callback=buffers.append)
+    else:
+        payload = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=buffers.append
+        )
     return payload, buffers
 
 

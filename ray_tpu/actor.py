@@ -60,24 +60,43 @@ class ActorMethod:
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         client = global_client()
         args_blob, deps = _submit.prepare_args(args, kwargs)
-        spec = TaskSpec(
-            task_id=TaskID.from_random(),
-            name=f"{self._method_name}",
-            function_id=self._handle._class_function_id,
-            function_blob=None,
-            args_blob=args_blob,
-            dependencies=deps,
-            num_returns=self._num_returns,
-            resources={},
-            actor_id=self._handle._actor_id,
-            method_name=self._method_name,
+        if self._num_returns in ("streaming", "dynamic"):
+            # Streaming actor method: GCS-routed so the pinned worker's
+            # stream_item reports and ordered dispatch share a channel.
+            return _submit.submit_streaming(
+                client, self._method_name, self._handle._class_function_id,
+                None, args_blob, deps, {},
+                actor_id=self._handle._actor_id,
+                method_name=self._method_name,
+            )
+        # Steady state: compact frame straight down the established
+        # direct connection — no TaskSpec, no GCS hop (reference: actor
+        # calls go gRPC straight to the actor process).
+        refs = client.call_actor_fast(
+            self._handle._actor_id.binary(),
+            self._method_name,
+            args_blob,
+            self._num_returns,
+            deps,
         )
-        # Direct transport first (no GCS hop; reference: actor calls go
-        # gRPC straight to the actor process); None means route via GCS
-        # (restartable actors, actor still pending, remote socket).
-        refs = client.submit_actor_direct(spec)
         if refs is None:
-            refs = client.submit(spec)
+            spec = TaskSpec(
+                task_id=TaskID.from_random(),
+                name=f"{self._method_name}",
+                function_id=self._handle._class_function_id,
+                function_blob=None,
+                args_blob=args_blob,
+                dependencies=deps,
+                num_returns=self._num_returns,
+                resources={},
+                actor_id=self._handle._actor_id,
+                method_name=self._method_name,
+            )
+            # Route resolution / buffering path; None means route via
+            # the GCS (restartable actors, actor pending, remote node).
+            refs = client.submit_actor_direct(spec)
+            if refs is None:
+                refs = client.submit(spec)
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

@@ -27,6 +27,9 @@ from .llama import Attention, LlamaConfig, RMSNorm, causal_lm_loss  # noqa: F401
 class MixtralConfig(LlamaConfig):
     num_experts: int = 8
     num_experts_per_tok: int = 2  # top-k routing
+    # Sparse models are small enough to save matmul outputs in remat:
+    # full recompute would cap MFU at 0.75 of peak for no memory win.
+    remat_policy: str = "dots"
     # Per-expert token capacity = capacity_factor * T * k / E.
     capacity_factor: float = 1.25
     router_aux_loss_coef: float = 0.02
@@ -64,7 +67,14 @@ CONFIGS = {
 
 
 class MoELayer(nn.Module):
-    """Top-k router + capacity-bounded dense dispatch/combine."""
+    """Top-k router + capacity-bounded sorted dispatch/combine.
+
+    Dispatch is gather/scatter on sorted (token, k) pairs — O(E*C*D)
+    memory traffic — instead of the GShard dense one-hot einsum, whose
+    [B,T,E,C] mask costs O(B*T^2*D) MXU FLOPs and hundreds of MB of
+    fp32 HBM traffic at long T. Shapes stay static (capacity-bounded
+    buffers, overflow slot), so XLA compiles it without ragged tensors;
+    gradients flow through the gathers and the gate weights."""
 
     cfg: MixtralConfig
 
@@ -88,33 +98,61 @@ class MoELayer(nn.Module):
             gate_vals.sum(-1, keepdims=True), 1e-9
         )
 
-        # Capacity-bounded one-hot dispatch mask [B, T, E, C]: position
-        # within each expert's buffer assigned by arrival order; tokens
-        # past capacity are dropped (their gate contribution vanishes).
-        onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [B,T,K,E]
-        expert_mask = onehot.sum(2)  # [B, T, E] (0/1 per expert)
-        position = (
-            jnp.cumsum(expert_mask, axis=1) - expert_mask
-        )  # tokens before me per expert
-        in_cap = (position < C) * expert_mask
-        pos_onehot = jax.nn.one_hot(
-            position.astype(jnp.int32), C, dtype=jnp.float32
-        )
-        dispatch = in_cap[..., None] * pos_onehot  # [B, T, E, C]
-        gates = (onehot * gate_vals[..., None]).sum(2)  # [B, T, E]
-        combine = gates[..., None] * dispatch  # [B, T, E, C]
-
         # Aux load-balance loss (Switch Transformer eq. 4): mean gate
         # fraction x mean dispatch fraction per expert.
+        onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [B,T,K,E]
+        expert_mask = onehot.sum(2)  # [B, T, E] (0/1 per expert)
         frac_tokens = expert_mask.mean(axis=(0, 1))
         frac_probs = probs.mean(axis=(0, 1))
         aux = E * jnp.sum(frac_tokens * frac_probs)
         self.sow("intermediates", "router_aux_loss", aux)
 
-        # Dispatch: [B,T,D] x [B,T,E,C] -> [E, B, C, D]; under GSPMD the
-        # expert axis of the result is mesh-sharded (all-to-all on ICI).
         xd = x.astype(cfg.dtype)
-        expert_in = jnp.einsum("btd,btec->ebcd", xd, dispatch.astype(cfg.dtype))
+        NK = T * K
+
+        # Arrival-order position of each token within its expert's
+        # buffer: cumsum over T of the small [B,T,E] mask (E is tiny) —
+        # no sort, no [B,T,E,C] one-hot.
+        position = (
+            jnp.cumsum(expert_mask, axis=1) - expert_mask
+        )  # [B, T, E] tokens before me per expert
+
+        def route_one(xrow, idx_row, pos_row):
+            """One batch row: the first C arrivals per expert own its
+            buffer slots; drops past capacity land in per-pair dump
+            slots (kept unique so XLA needs no collision handling).
+
+            TPU shape of the dispatch: scatter only the int32 slot->token
+            inverse map (cheap scalar scatter), then fill the buffer with
+            a row GATHER — row scatters serialize on TPU, row gathers
+            vectorize. Pair order stays token-major, so combine is a
+            reshape-sum, not a scatter."""
+            e_flat = idx_row.reshape(NK)  # expert of each (token, k) pair
+            pos = jnp.take_along_axis(
+                pos_row, idx_row, axis=1
+            ).reshape(NK).astype(jnp.int32)  # position within expert
+            keep = pos < C
+            slot = jnp.where(
+                keep, e_flat * C + pos, E * C + jnp.arange(NK, dtype=jnp.int32)
+            )
+            tok_ids = jnp.arange(NK, dtype=jnp.int32) // K
+            inv = (
+                jnp.full((E * C + NK,), T, jnp.int32)
+                .at[slot]
+                .set(tok_ids, unique_indices=True)
+            )
+            x_pad = jnp.concatenate(
+                [xrow, jnp.zeros((1, D), xrow.dtype)], axis=0
+            )
+            buf = x_pad[inv[: E * C]]  # [E*C, D] row gather
+            return buf, jnp.minimum(slot, E * C)
+
+        buf, slot = jax.vmap(route_one)(
+            xd, gate_idx, position.astype(jnp.float32)
+        )
+        # [B, E*C, D] -> [E, B, C, D]; under GSPMD the expert axis is
+        # mesh-sharded (all-to-all over ICI).
+        expert_in = buf.reshape(B, E, C, D).transpose(1, 0, 2, 3)
         expert_in = with_logical_constraint(
             expert_in, ("expert", "batch", None, "embed")
         )
@@ -135,9 +173,21 @@ class MoELayer(nn.Module):
         act = nn.silu(h) * u
         expert_out = jnp.einsum("ebcf,efd->ebcd", act, w_down.astype(cfg.dtype))
 
-        # Combine back to token order, weighted by gates.
-        out = jnp.einsum(
-            "ebcd,btec->btd", expert_out, combine.astype(cfg.dtype)
+        # Combine back to token order, weighted by gates: gather each
+        # pair's expert output (dropped pairs read the zero dump row),
+        # scale, and reduce the K pairs of every token — pair order is
+        # token-major, so the reduction is a reshape-sum, no scatter.
+        expert_out = expert_out.transpose(1, 0, 2, 3).reshape(B, E * C, D)
+
+        def combine_one(eo_row, slot_row, gate_row):
+            eo_row = jnp.concatenate(
+                [eo_row, jnp.zeros((1, D), eo_row.dtype)], axis=0
+            )
+            pair_out = eo_row[slot_row] * gate_row[:, None]
+            return pair_out.reshape(T, K, D).sum(1)
+
+        out = jax.vmap(combine_one)(
+            expert_out, slot, gate_vals.astype(cfg.dtype).reshape(B, NK)
         )
         return with_logical_constraint(out, ("batch", "seq", "embed"))
 
@@ -176,11 +226,13 @@ class MixtralForCausalLM(nn.Module):
         )
         x = emb(input_ids)
         x = with_logical_constraint(x, ("batch", "seq", "embed"))
+        from .llama import remat_policy
+
         layer_cls = MoEDecoderLayer
         if cfg.remat:
             layer_cls = nn.remat(
                 MoEDecoderLayer, prevent_cse=False,
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=remat_policy(cfg),
             )
         for i in range(cfg.num_layers):
             x = layer_cls(cfg, mesh=self.mesh, name=f"layers_{i}")(x, positions)

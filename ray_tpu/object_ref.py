@@ -95,3 +95,77 @@ class ObjectRef:
         import asyncio
 
         return asyncio.wrap_future(self.future()).__await__()
+
+
+_STREAM_END = object()
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs produced by a ``num_returns="streaming"``
+    task (reference: StreamingObjectRefGenerator, _raylet.pyx:1289).
+
+    Each ``next()`` blocks until the executor has sealed the next yield
+    as its own object (reported incrementally through the control
+    plane), then returns its ref — the consumer observes outputs while
+    the task is still running. A generator that raises mid-stream
+    delivers the error on the next() after its last yield. Not
+    picklable (consume where created); lineage reconstruction does not
+    cover streamed outputs.
+    """
+
+    def __init__(self, task_id: bytes, client, owner: bytes):
+        self._task_id = task_id
+        self._client = client
+        self._owner = owner
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        nxt = self._next_or_end()
+        if nxt is _STREAM_END:
+            raise StopIteration
+        return nxt
+
+    def _next_or_end(self):
+        reply = self._client.request(
+            {
+                "type": "stream_next",
+                "task_id": self._task_id,
+                "index": self._index,
+            }
+        )
+        if reply.get("available"):
+            oid = ObjectID(ObjectID.bytes_for_return(self._task_id, self._index))
+            self._index += 1
+            return ObjectRef(oid, self._owner)
+        err = reply.get("error")
+        if err is not None:
+            from ._private import serialization
+            from .exceptions import RayTaskError
+
+            e = serialization.unpack(err)
+            if isinstance(e, RayTaskError):
+                raise e.as_instanceof_cause()
+            raise e
+        return _STREAM_END
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        nxt = await loop.run_in_executor(None, self._next_or_end)
+        if nxt is _STREAM_END:
+            raise StopAsyncIteration
+        return nxt
+
+    def completed(self) -> int:
+        """Items yielded so far (refs this generator has handed out)."""
+        return self._index
+
+    def __repr__(self):
+        return f"ObjectRefGenerator(task={self._task_id.hex()}, next={self._index})"

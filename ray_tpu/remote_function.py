@@ -44,6 +44,23 @@ class RemoteFunction:
         self._default_options = default_options
         self._blob: Optional[bytes] = None
         self._function_id: Optional[bytes] = None
+        # Simple-options analysis, computed once: plain tasks (no
+        # placement, no runtime_env, no retries-with-exceptions) take a
+        # submit path that skips the per-call option plumbing.
+        opts = default_options
+        self._simple = not any(
+            opts.get(k)
+            for k in (
+                "placement_group",
+                "scheduling_strategy",
+                "runtime_env",
+                "name",
+            )
+        ) and (opts.get("placement_group_bundle_index") in (None, -1))
+        self._resources = _submit.resources_from_options(opts)
+        self._num_returns = opts.get("num_returns", 1) or 1
+        self._max_retries = opts.get("max_retries", 0) or 0
+        self._retry_exceptions = bool(opts.get("retry_exceptions", False))
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -77,7 +94,50 @@ class RemoteFunction:
         self._ensure_pickled()
         opts = self._default_options
         args_blob, deps = _submit.prepare_args(args, kwargs)
-        num_returns = opts.get("num_returns", 1) or 1
+        num_returns = self._num_returns
+        if num_returns in ("streaming", "dynamic"):
+            # Streaming generator: each yield seals as its own object,
+            # reported incrementally; the caller iterates refs while the
+            # task runs (reference: num_returns="streaming",
+            # _raylet.pyx:1289). Routed via the GCS so stream_item
+            # reports and scheduling share one ordered channel.
+            return _submit.submit_streaming(
+                client, self._fn.__name__, self._function_id,
+                client.register_function_once(self._function_id, self._blob),
+                args_blob, deps, _submit.resources_from_options(opts),
+            )
+        if self._simple:
+            from .util import tracing
+
+            if not tracing.enabled():
+                spec = TaskSpec.__new__(TaskSpec)
+                spec.task_id = TaskID.from_random()
+                spec.name = self._fn.__name__
+                spec.function_id = self._function_id
+                spec.function_blob = client.register_function_once(
+                    self._function_id, self._blob
+                )
+                spec.args_blob = args_blob
+                spec.dependencies = deps
+                spec.num_returns = num_returns
+                spec.resources = self._resources
+                spec.actor_creation = False
+                spec.actor_id = None
+                spec.method_name = ""
+                spec.max_restarts = 0
+                spec.max_retries = self._max_retries
+                spec.retry_exceptions = self._retry_exceptions
+                spec.max_concurrency = 1
+                spec.placement_group_id = None
+                spec.placement_group_bundle_index = -1
+                spec.scheduling_strategy = None
+                spec.actor_name = None
+                spec.lifetime = None
+                spec.runtime_env = None
+                refs = client.submit_task_leased(spec)
+                if refs is None:
+                    refs = client.submit(spec)
+                return refs[0] if num_returns == 1 else refs
         pg = opts.get("placement_group")
         pg_id: Optional[PlacementGroupID] = None
         bundle_index = opts.get("placement_group_bundle_index", -1)

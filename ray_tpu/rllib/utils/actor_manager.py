@@ -41,7 +41,9 @@ class FaultTolerantActorManager:
         fn_name: str,
         *args,
         kwargs_per_actor: Optional[Dict[int, dict]] = None,
-        timeout: Optional[float] = 120.0,
+        # Liveness bound, not a perf assertion: a restarted actor pays a
+        # fresh jax compile, which on a contended host can take minutes.
+        timeout: Optional[float] = 600.0,
         **kwargs,
     ) -> List[Tuple[int, Any]]:
         """Call ``actor.<fn_name>(*args)`` on every actor; returns

@@ -18,11 +18,14 @@ from .common import (
 
 class ApplicationState:
     def __init__(self, name: str, route_prefix: Optional[str], ingress: str,
-                 deployment_names: List[str]):
+                 deployment_names: List[str], ingress_streaming: bool = False):
         self.name = name
         self.route_prefix = route_prefix
         self.ingress = ingress
         self.deployment_names = deployment_names
+        # Ingress __call__ is a (sync/async) generator: the HTTP proxy
+        # serves this app with chunked streaming responses.
+        self.ingress_streaming = ingress_streaming
         self.status = ApplicationStatus.DEPLOYING
         self.message = ""
         self.deleting = False
@@ -38,7 +41,8 @@ class ApplicationStateManager:
         self._apps: Dict[str, ApplicationState] = {}
         self._last_routes: Optional[dict] = None
 
-    def deploy(self, name, route_prefix, ingress, deployment_names):
+    def deploy(self, name, route_prefix, ingress, deployment_names,
+               ingress_streaming: bool = False):
         # Remove deployments dropped by a redeploy.
         old = self._apps.get(name)
         if old:
@@ -46,7 +50,7 @@ class ApplicationStateManager:
                 if dep.name not in deployment_names:
                     self._dsm.delete(dep)
         self._apps[name] = ApplicationState(
-            name, route_prefix, ingress, deployment_names
+            name, route_prefix, ingress, deployment_names, ingress_streaming
         )
 
     def delete(self, name: str):
@@ -90,6 +94,7 @@ class ApplicationStateManager:
             app.route_prefix: {
                 "app_name": app.name,
                 "ingress": app.ingress,
+                "streaming": app.ingress_streaming,
             }
             for app in self._apps.values()
             if app.route_prefix and not app.deleting

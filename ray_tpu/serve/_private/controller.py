@@ -68,7 +68,10 @@ class ServeController:
         name/serialized_callable/init_args/init_kwargs/config."""
         infos = pickle.loads(deployments_blob)
         names = [d["name"] for d in infos]
-        self._asm.deploy(name, route_prefix, ingress, names)
+        self._asm.deploy(
+            name, route_prefix, ingress, names,
+            ingress_streaming=_ingress_is_streaming(infos, ingress),
+        )
         for d in infos:
             dep_id = DeploymentID(d["name"], name)
             self._dsm.deploy(
@@ -150,3 +153,27 @@ class ServeController:
 def _parse_dep_id(s: str) -> DeploymentID:
     app, _, name = s.partition("#")
     return DeploymentID(name, app)
+
+
+def _ingress_is_streaming(infos, ingress_name: str) -> bool:
+    """Deploy-time inspection: a generator (or async-generator) ingress
+    handler means the HTTP proxy should stream chunked responses
+    (reference: Serve streams when the app returns StreamingResponse)."""
+    import inspect
+
+    for d in infos:
+        if d["name"] != ingress_name:
+            continue
+        try:
+            c = pickle.loads(d["serialized_callable"])
+        except Exception:  # noqa: BLE001 - env-specific callables
+            return False
+        target = c if not inspect.isclass(c) else getattr(c, "__call__", None)
+        return bool(
+            target is not None
+            and (
+                inspect.isgeneratorfunction(target)
+                or inspect.isasyncgenfunction(target)
+            )
+        )
+    return False

@@ -85,17 +85,49 @@ class ProxyActor:
             headers=dict(request.headers),
             body=body,
         )
+        if self._routes[prefix].get("streaming"):
+            return await self._handle_streaming(request, handle, req)
         try:
             result = await handle.remote(req)
         except Exception as e:  # noqa: BLE001 - surface as 500
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         return _encode_response(web, result)
 
+    async def _handle_streaming(self, request, handle, req):
+        """Generator ingress: write each yielded chunk as it arrives —
+        the client observes output while the handler is still running
+        (reference: Serve token streaming over the generator path)."""
+        from aiohttp import web
+
+        gen = handle.options(stream=True).remote(req)
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/plain; charset=utf-8"},
+        )
+        await resp.prepare(request)
+        try:
+            async for chunk in gen:
+                await resp.write(_encode_chunk(chunk))
+        except Exception as e:  # noqa: BLE001 - stream already started
+            await resp.write(
+                f"\n[stream error] {type(e).__name__}: {e}".encode()
+            )
+        await resp.write_eof()
+        return resp
+
     async def shutdown(self):
         if self._long_poll:
             self._long_poll.stop()
         if self._runner:
             await self._runner.cleanup()
+
+
+def _encode_chunk(chunk) -> bytes:
+    if isinstance(chunk, bytes):
+        return chunk
+    if isinstance(chunk, str):
+        return chunk.encode()
+    return (json.dumps(chunk) + "\n").encode()
 
 
 def _encode_response(web, result):

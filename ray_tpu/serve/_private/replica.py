@@ -143,6 +143,34 @@ class ReplicaActor:
         finally:
             self._num_ongoing -= 1
 
+    async def handle_request_streaming(
+        self, meta: RequestMetadata, *args, **kwargs
+    ):
+        """Streaming variant: an async generator the worker runtime
+        drives as a ``num_returns="streaming"`` task — each yielded
+        chunk seals as its own object and reaches the caller while the
+        handler is still producing (reference: replica.py:471
+        handle_request_streaming)."""
+        if self._num_ongoing >= self._config.max_ongoing_requests:
+            raise RejectedError(self._replica_id)
+        self._num_ongoing += 1
+        try:
+            _request_model_id.set(meta.multiplexed_model_id)
+            target = self._resolve_method(meta.call_method)
+            result = target(*args, **kwargs)
+            if inspect.isawaitable(result):
+                result = await result
+            if hasattr(result, "__aiter__"):
+                async for item in result:
+                    yield item
+            elif inspect.isgenerator(result):
+                for item in result:
+                    yield item
+            else:
+                yield result
+        finally:
+            self._num_ongoing -= 1
+
     def _resolve_method(self, name: str):
         if self._is_function:
             return self._callable

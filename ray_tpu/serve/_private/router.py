@@ -163,6 +163,54 @@ class Router:
         fut.add_done_callback(self._dec_queued)
         return fut
 
+    def assign_request_streaming(self, meta: RequestMetadata, args, kwargs):
+        """Streaming assignment: returns (async_value_generator, loop).
+        The generator runs on the router loop and yields chunk VALUES;
+        it carries the same admission semantics as the unary path — a
+        replica that rejects (or dies) before producing anything is
+        retried elsewhere, and the in-flight estimate covers the whole
+        stream's lifetime (reference: router streaming calls ride the
+        generator path with rejection retries)."""
+        return self._stream_values(meta, args, kwargs), self._loop
+
+    async def _stream_values(self, meta: RequestMetadata, args, kwargs):
+        from .replica import RejectedError
+
+        rs = self._replica_set
+        args, kwargs = await _resolve_composed_args(args, kwargs)
+        loop = asyncio.get_running_loop()
+        while True:
+            rid = self._scheduler.choose(meta)
+            if rid is None:
+                await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
+                continue
+            handle = rs.handles.get(rid)
+            if handle is None:
+                await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
+                continue
+            rs.inflight[rid] += 1
+            yielded = False
+            try:
+                refgen = handle.handle_request_streaming.options(
+                    num_returns="streaming"
+                ).remote(meta, *args, **kwargs)
+                async for ref in refgen:
+                    value = await loop.run_in_executor(None, _get_one, ref)
+                    yielded = True
+                    yield value
+                return
+            except RejectedError:
+                if yielded:
+                    raise
+                await asyncio.sleep(ASSIGN_RETRY_BACKOFF_S)
+            except Exception as e:  # noqa: BLE001
+                if yielded or not _is_actor_death(e):
+                    raise
+                rs.replicas.pop(rid, None)
+                rs.handles.pop(rid, None)
+            finally:
+                rs.inflight[rid] -= 1
+
     def _dec_queued(self, _fut):
         with self._queued_lock:
             self._num_queued -= 1
@@ -232,6 +280,12 @@ async def _resolve_composed_args(args, kwargs):
         tuple([await conv(a) for a in args]),
         {k: await conv(v) for k, v in kwargs.items()},
     )
+
+
+def _get_one(ref):
+    import ray_tpu
+
+    return ray_tpu.get(ref)
 
 
 def _is_actor_death(e: BaseException) -> bool:

@@ -1,0 +1,159 @@
+"""Streaming generators: num_returns="streaming" end to end.
+
+Reference behavior: python/ray/_raylet.pyx:1289 (streaming-generator
+reporting) + src/ray/core_worker/task_manager.h:208 — each yield seals
+as its own object, the consumer iterates refs while the task runs.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(num_returns="streaming")
+def count_stream(n, delay=0.0):
+    for i in range(n):
+        if delay:
+            time.sleep(delay)
+        yield i * 10
+
+
+@ray_tpu.remote(num_returns="streaming")
+def failing_stream():
+    yield 1
+    yield 2
+    raise ValueError("boom mid-stream")
+
+
+def test_stream_basic(cluster):
+    gen = count_stream.remote(5)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    vals = [ray_tpu.get(ref) for ref in gen]
+    assert vals == [0, 10, 20, 30, 40]
+
+
+def test_stream_observes_partial_output_before_completion(cluster):
+    """The defining property: the consumer sees early yields while the
+    producer is still running (here: still sleeping between yields)."""
+    t0 = time.monotonic()
+    gen = count_stream.remote(6, delay=0.5)
+    first = ray_tpu.get(next(gen))
+    first_latency = time.monotonic() - t0
+    assert first == 0
+    # Full stream takes >=3s of producer sleeps; the first item must
+    # arrive while most of that is still ahead.
+    assert first_latency < 2.0, f"first item took {first_latency:.1f}s"
+    rest = [ray_tpu.get(r) for r in gen]
+    assert rest == [10, 20, 30, 40, 50]
+
+
+def test_stream_error_surfaces_after_last_yield(cluster):
+    gen = failing_stream.remote()
+    assert ray_tpu.get(next(gen)) == 1
+    assert ray_tpu.get(next(gen)) == 2
+    with pytest.raises(ValueError, match="boom mid-stream"):
+        next(gen)
+
+
+def test_stream_non_generator_returns_single_item(cluster):
+    @ray_tpu.remote(num_returns="streaming")
+    def plain():
+        return 42
+
+    gen = plain.remote()
+    assert ray_tpu.get(next(gen)) == 42
+    with pytest.raises(StopIteration):
+        next(gen)
+
+
+def test_actor_method_streaming(cluster):
+    @ray_tpu.remote
+    class Tokenizer:
+        def stream_tokens(self, text):
+            for tok in text.split():
+                yield tok
+
+    a = Tokenizer.remote()
+    toks = [
+        ray_tpu.get(r)
+        for r in a.stream_tokens.options(num_returns="streaming").remote(
+            "the quick brown fox"
+        )
+    ]
+    assert toks == ["the", "quick", "brown", "fox"]
+    ray_tpu.kill(a)
+
+
+def test_serve_handle_streaming(cluster):
+    from ray_tpu import serve
+
+    serve.start(proxy=False)
+    try:
+        @serve.deployment
+        class TokenGen:
+            def __call__(self, prompt):
+                for tok in f"echo {prompt}".split():
+                    yield tok + " "
+
+        handle = serve.run(TokenGen.bind(), name="tok", route_prefix=None)
+        chunks = list(handle.options(stream=True).remote("hi there"))
+        assert chunks == ["echo ", "hi ", "there "]
+        serve.delete("tok")
+    finally:
+        serve.shutdown()
+
+
+def test_serve_http_streams_partial_output_before_completion(cluster):
+    """VERDICT round-2 item 3 'done' criterion: an HTTP client observes
+    partial output while the handler is still producing."""
+    import urllib.request
+
+    from ray_tpu import serve
+
+    serve.start(serve.HTTPOptions(host="127.0.0.1", port=18097))
+    try:
+        @serve.deployment
+        async def slow_tokens(request):
+            import asyncio as aio
+
+            for i in range(5):
+                yield f"tok{i} "
+                await aio.sleep(0.4)
+
+        serve.run(slow_tokens.bind(), name="stream_app", route_prefix="/")
+        t0 = time.monotonic()
+        resp = urllib.request.urlopen("http://127.0.0.1:18097/", timeout=30)
+        first = resp.read(5)  # one chunk
+        first_latency = time.monotonic() - t0
+        assert first == b"tok0 "
+        # Producer sleeps ~2s total after the first token; seeing it this
+        # early proves the response streams rather than buffering.
+        assert first_latency < 1.5, f"first chunk took {first_latency:.1f}s"
+        rest = resp.read().decode()
+        total_latency = time.monotonic() - t0
+        assert rest == "tok1 tok2 tok3 tok4 "
+        assert total_latency > first_latency + 1.0  # really was incremental
+        serve.delete("stream_app")
+    finally:
+        serve.shutdown()
+
+
+def test_stream_large_items_via_store(cluster):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def big_blocks(n):
+        for i in range(n):
+            yield np.full((300 * 1024,), i, dtype=np.uint8)  # > inline cap
+
+    got = [ray_tpu.get(r) for r in big_blocks.remote(3)]
+    assert [int(g[0]) for g in got] == [0, 1, 2]
+    assert all(len(g) == 300 * 1024 for g in got)
