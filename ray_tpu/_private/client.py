@@ -592,7 +592,8 @@ class CoreClient:
             raise RayTpuError(f"put failed: {reply}")
         return fields
 
-    def _materialize(self, reply: Dict[str, Any], oid: ObjectID) -> Any:
+    def _materialize(self, reply: Dict[str, Any], oid: ObjectID,
+                     _retried: bool = False) -> Any:
         from ..exceptions import ObjectLostError
 
         if reply.get("status") == "FAILED":
@@ -604,6 +605,18 @@ class CoreClient:
             raise ObjectLostError(f"object {oid.hex()} lost (node died)")
         if reply.get("inline") is not None:
             return serialization.unpack(reply["inline"])
+        spilled = reply.get("spilled_path")
+        if spilled is not None and not self.store.contains(oid):
+            # Restore rung of the memory-pressure ladder: the object was
+            # spilled to disk under pool pressure. Same-host: read the
+            # file directly; cross-node: fall through to the transfer
+            # plane (the owner's transfer server restores from its
+            # spill dir).
+            try:
+                with open(spilled, "rb") as f:
+                    return serialization.unpack(f.read())
+            except OSError:
+                pass
         # Cross-node: the object's primary copy lives on another node —
         # pull it into the local store first (reference: raylet
         # PullManager fetching via the object directory).
@@ -622,6 +635,14 @@ class CoreClient:
         try:
             return self.store.get(oid)
         except FileNotFoundError:
+            if not _retried:
+                # The copy may have moved while this reply was in
+                # flight (spilled to disk between directory lookup and
+                # our read): ask the directory again once.
+                fresh = self.conn.request(
+                    {"type": "get_object", "object_id": oid.binary()}
+                )
+                return self._materialize(fresh, oid, _retried=True)
             # Directory says READY but the data is gone (evicted).
             raise ObjectLostError(
                 f"object {oid.hex()} missing from the local store (evicted)"

@@ -34,8 +34,22 @@ _DEFAULTS: Dict[str, Any] = {
     # RAY_testing_asio_delay_us, ray_config_def.h:832).
     "testing_rpc_delay_us": "",
     # Object store.
-    "object_store_memory_bytes": 0,  # 0 = unlimited (shm-backed)
+    "object_store_memory_bytes": 0,  # 0 = auto-size the shm pool
+    # Spill-to-disk for sealed objects under pool pressure (reference:
+    # local_object_manager.h:41). "" = <session_dir>/spill.
     "object_spilling_directory": "",
+    # Pool-utilization fraction that triggers background spilling of
+    # cold sealed objects (reference: object_spilling_threshold).
+    "object_spilling_threshold": 0.8,
+    # Memory monitor (reference: memory_monitor.h:52 + the retriable-
+    # FIFO worker killing policy): sample host memory every refresh; at
+    # or above the usage threshold, kill the newest running retriable
+    # task first (resubmitted), then non-retriable (OutOfMemoryError).
+    "memory_monitor_refresh_ms": 250,
+    "memory_usage_threshold": 0.95,
+    # Testing hook: read the usage fraction from this file instead of
+    # /proc/meminfo.
+    "testing_memory_usage_file": "",
     # Metrics.
     "metrics_report_interval_ms": 1000,
 }

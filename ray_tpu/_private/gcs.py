@@ -74,6 +74,11 @@ class ObjectEntry:
     task_pins: int = 0
     child_pins: int = 0
     children: List[bytes] = field(default_factory=list)
+    # Memory-pressure ladder (reference: local_object_manager.h:41):
+    # cold sealed objects spill to disk under pool pressure; gets read
+    # the file (or restore through the transfer plane cross-node).
+    spilled_path: Optional[str] = None
+    last_access: float = 0.0
 
 
 @dataclass
@@ -85,6 +90,11 @@ class WorkerHandle:
     proc: Optional[subprocess.Popen] = None
     pid: int = 0
     current_task: Optional[TaskSpec] = None
+    task_started_at: float = 0.0  # OOM killing policy: newest-first
+    # Set (under the GCS lock) before a deliberate kill so the racing
+    # conn-close death handler reports the intended cause, not a
+    # generic crash.
+    death_reason_hint: str = ""
     actor_id: Optional[ActorID] = None
     # Dispatched-but-unfinished specs (task_id -> spec); failed on death.
     inflight: Dict[bytes, TaskSpec] = field(default_factory=dict)
@@ -247,9 +257,27 @@ class GcsServer:
         self._health_thread = threading.Thread(
             target=self._health_loop, name="gcs-health", daemon=True
         )
+        # Memory-pressure ladder: background spilling of cold sealed
+        # objects at high pool utilization (reference:
+        # local_object_manager.h:41-110) + a host-memory monitor that
+        # kills the newest retriable task first under pressure
+        # (reference: memory_monitor.h:52,
+        # worker_killing_policy_retriable_fifo.h).
+        self.spill_dir = RayConfig.object_spilling_directory or os.path.join(
+            session_dir, "spill"
+        )
+        os.environ["RAY_TPU_SPILL_DIR"] = self.spill_dir
+        self._spill_thread = threading.Thread(
+            target=self._spill_loop, name="gcs-spill", daemon=True
+        )
+        self._memory_thread = threading.Thread(
+            target=self._memory_loop, name="gcs-memory", daemon=True
+        )
         self._accept_thread.start()
         self._sched_thread.start()
         self._health_thread.start()
+        self._spill_thread.start()
+        self._memory_thread.start()
         # Prestart a few workers so the first task doesn't pay spawn latency
         # (reference: worker_pool.cc:1323 PrestartWorkers).
         with self._lock:
@@ -528,6 +556,7 @@ class GcsServer:
             entry.segment = r.get("segment")
             entry.size = r.get("size", 0)
             entry.node_id = w.node_id if w else None
+            entry.last_access = time.time()
             for child in r.get("children", []):
                 entry.children.append(child)
                 self.objects.setdefault(child, ObjectEntry()).child_pins += 1
@@ -643,6 +672,7 @@ class GcsServer:
                 entry.segment = r.get("segment")
                 entry.size = r.get("size", 0)
                 entry.node_id = w.node_id if w else None
+                entry.last_access = time.time()
                 for child in r.get("children", []):
                     entry.children.append(child)
                     self.objects.setdefault(
@@ -707,6 +737,7 @@ class GcsServer:
             entry.inline = msg.get("inline")
             entry.segment = msg.get("segment")
             entry.size = msg.get("size", 0)
+            entry.last_access = time.time()
             if entry.segment is not None:
                 nid = state.get("obj_node_id")
                 entry.node_id = NodeID(nid) if nid else self.head_node.node_id
@@ -721,6 +752,7 @@ class GcsServer:
             return {"ok": True, "status": FAILED, "error": entry.error}
         if entry.status == LOST:
             return {"ok": True, "status": LOST}
+        entry.last_access = time.time()
         fields = {
             "ok": True,
             "status": READY,
@@ -728,7 +760,11 @@ class GcsServer:
             "segment": entry.segment,
             "size": entry.size,
         }
-        if entry.segment is not None and entry.node_id is not None:
+        if entry.spilled_path is not None:
+            fields["spilled_path"] = entry.spilled_path
+        if (
+            entry.segment is not None or entry.spilled_path is not None
+        ) and entry.node_id is not None:
             # Location for cross-node pulls (reference: the ownership-based
             # object directory resolving a copy's node + transfer endpoint).
             node = self.nodes.get(entry.node_id.binary())
@@ -784,6 +820,11 @@ class GcsServer:
             return
         if entry.segment:
             self._store.delete(ObjectID(oid))
+        if entry.spilled_path:
+            try:
+                os.unlink(entry.spilled_path)
+            except OSError:
+                pass
         freed.append(oid)
         for child in entry.children:
             ce = self.objects.get(child)
@@ -1408,6 +1449,172 @@ class GcsServer:
             if node is not None:
                 node.last_heartbeat = time.time()
 
+    # ------------------------------------------------ memory-pressure ladder
+
+    def _spill_loop(self):
+        """Evict→spill rung: at high pool utilization, write the coldest
+        sealed, unpinned head-node objects to disk and free their pool
+        space; gets fall back to the spill file (same node) or restore
+        through the transfer plane (cross-node)."""
+        pool = getattr(self._store, "_pool", None)
+        if pool is None:
+            return  # segment-fallback store: no bounded arena to manage
+        while not self._shutdown:
+            time.sleep(0.2)
+            try:
+                st = pool.stats()
+            except Exception:  # noqa: BLE001
+                return
+            cap = st.get("pool_size") or st.get("arena_size") or 0
+            if not cap:
+                continue
+            frac = st["bytes_in_use"] / cap
+            threshold = RayConfig.object_spilling_threshold
+            if frac < threshold:
+                continue
+            target = max(0.0, threshold - 0.1)
+            to_free = int((frac - target) * cap)
+            with self._lock:
+                head = self.head_node.node_id
+                candidates = sorted(
+                    (
+                        (e.last_access, oid, e)
+                        for oid, e in self.objects.items()
+                        if e.status == READY
+                        and e.segment == "pool"
+                        and e.spilled_path is None
+                        and e.task_pins == 0
+                        and e.node_id == head
+                    ),
+                    key=lambda t: t[0],
+                )
+            freed = 0
+            for _, oid, entry in candidates:
+                if freed >= to_free:
+                    break
+                freed += self._spill_one(oid, entry)
+
+    def _spill_one(self, oid: bytes, entry: ObjectEntry) -> int:
+        """Write one sealed object to the spill dir, then free its pool
+        copy. Ordering matters: the file + directory update land before
+        the delete so a concurrent directory lookup always finds one
+        valid copy (a get reply already in flight falls back to a
+        re-request on store miss — client._materialize)."""
+        from .object_store import spill_path
+
+        raw = self._store.get_raw(ObjectID(oid))
+        if raw is None:
+            return 0
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = spill_path(self.spill_dir, ObjectID(oid))
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, path)
+            n = len(raw)
+        except OSError:
+            return 0
+        finally:
+            self._store.release_raw(ObjectID(oid))
+        with self._lock:
+            if self.objects.get(oid) is not entry:
+                # Freed while we were writing: nothing will ever unlink
+                # the file through the directory — do it ourselves.
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return 0
+            entry.spilled_path = path
+            entry.segment = None
+        self._store.delete(ObjectID(oid))
+        return n
+
+    def _memory_usage_fraction(self) -> Optional[float]:
+        test_file = RayConfig.testing_memory_usage_file
+        if test_file:
+            try:
+                with open(test_file) as f:
+                    return float(f.read().strip())
+            except (OSError, ValueError):
+                return None
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, rest = line.partition(":")
+                    info[k] = int(rest.split()[0])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            if not total:
+                return None
+            return 1.0 - avail / total
+        except OSError:
+            return None
+
+    def _memory_loop(self):
+        """OOM rung: above the usage threshold, kill one task-running
+        worker per tick — newest retriable task first (it resubmits),
+        then newest non-retriable (fails with OutOfMemoryError)."""
+        while not self._shutdown:
+            time.sleep(RayConfig.memory_monitor_refresh_ms / 1000.0)
+            frac = self._memory_usage_fraction()
+            if frac is None or frac < RayConfig.memory_usage_threshold:
+                continue
+            with self._lock:
+                victims = [
+                    w
+                    for w in self.workers.values()
+                    if w.proc is not None
+                    and (
+                        (
+                            w.state == W_BUSY
+                            and w.current_task is not None
+                            and not w.current_task.actor_creation
+                        )
+                        # Leased (direct-transport) workers run tasks the
+                        # GCS can't see; their clients decide retry on
+                        # the conn-loss they observe.
+                        or w.state == W_LEASED
+                    )
+                ]
+                if not victims:
+                    continue
+                # Kill order: GCS-retriable first, then leased, then
+                # non-retriable; newest first within each class
+                # (reference: retriable-FIFO killing policy).
+                def _klass(w):
+                    if w.state == W_LEASED:
+                        return 1
+                    return 0 if w.current_task.max_retries > 0 else 2
+
+                victims.sort(key=lambda w: (_klass(w), -w.task_started_at))
+                victim = victims[0]
+                name = (
+                    victim.current_task.name
+                    if victim.current_task is not None
+                    else "<leased>"
+                )
+                # Under the lock so the racing conn-close handler
+                # reports OOM, not a generic crash.
+                victim.death_reason_hint = (
+                    f"out-of-memory: host usage {frac:.2f}"
+                )
+                try:
+                    victim.proc.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+            sys.stderr.write(
+                f"gcs: memory pressure {frac:.2f} >= "
+                f"{RayConfig.memory_usage_threshold}: killed worker running "
+                f"'{name}'\n"
+            )
+            self._handle_worker_death(
+                victim.worker_id.binary(),
+                f"out-of-memory: host usage {frac:.2f}",
+            )
+
     def _health_loop(self):
         """Declare daemon nodes dead when their heartbeats stop, even if
         the TCP connection stays established (partition, SIGSTOP, hang)
@@ -1667,6 +1874,7 @@ class GcsServer:
                 continue
             worker.state = W_BUSY
             worker.current_task = spec
+            worker.task_started_at = time.time()
             worker.inflight[spec.task_id.binary()] = spec
             if spec.actor_creation:
                 worker.actor_id = spec.actor_id
@@ -1746,12 +1954,24 @@ class GcsServer:
         return w
 
     def _handle_worker_death(self, wid: bytes, reason: str, respawn: bool = False):
-        from ..exceptions import WorkerCrashedError
+        from ..exceptions import OutOfMemoryError, WorkerCrashedError
+
+        exc_cls = (
+            OutOfMemoryError if reason.startswith("out-of-memory") else
+            WorkerCrashedError
+        )
 
         with self._lock:
             w = self.workers.get(wid)
             if w is None or w.state == W_DEAD:
                 return
+            if w.death_reason_hint:
+                reason = w.death_reason_hint
+                exc_cls = (
+                    OutOfMemoryError
+                    if reason.startswith("out-of-memory")
+                    else WorkerCrashedError
+                )
             prev_state = w.state
             w.state = W_DEAD
             node = self.nodes.get(w.node_id.binary())
@@ -1778,7 +1998,7 @@ class GcsServer:
                     self._pending.append(spec)
                 else:
                     self._fail_task_returns(
-                        spec, WorkerCrashedError(f"worker died: {reason}")
+                        spec, exc_cls(f"worker died: {reason}")
                     )
             if w.actor_id is not None:
                 actor = self.actors.get(w.actor_id.binary())

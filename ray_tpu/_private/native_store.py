@@ -146,6 +146,8 @@ class PoolStore:
     # ------------------------------------------------------------ objects
     def create(self, object_id: bytes, size: int) -> Optional[memoryview]:
         """Returns a writable view of the payload, or None (full/exists)."""
+        if not self._h:
+            return None
         err = ctypes.c_int32(0)
         off = self._lib.store_create_object(
             self._h, object_id, size, ctypes.byref(err)
@@ -155,10 +157,12 @@ class PoolStore:
         return self.buf[off : off + size]
 
     def seal(self, object_id: bytes) -> bool:
-        return self._lib.store_seal(self._h, object_id) == 0
+        return bool(self._h) and self._lib.store_seal(self._h, object_id) == 0
 
     def get(self, object_id: bytes) -> Optional[memoryview]:
         """Read-side view; caller must release() when done with it."""
+        if not self._h:
+            return None
         off = ctypes.c_uint64(0)
         size = ctypes.c_uint64(0)
         rc = self._lib.store_get(
@@ -169,15 +173,21 @@ class PoolStore:
         return self.buf[off.value : off.value + size.value]
 
     def contains(self, object_id: bytes) -> bool:
-        return self._lib.store_contains(self._h, object_id) == 1
+        return bool(self._h) and self._lib.store_contains(self._h, object_id) == 1
 
     def release(self, object_id: bytes) -> None:
-        self._lib.store_release(self._h, object_id)
+        if self._h:
+            self._lib.store_release(self._h, object_id)
 
     def delete(self, object_id: bytes) -> None:
-        self._lib.store_delete(self._h, object_id)
+        if self._h:
+            self._lib.store_delete(self._h, object_id)
 
     def stats(self) -> dict:
+        # Detached-handle calls (e.g. a monitor thread racing shutdown)
+        # must fail as exceptions, not native crashes.
+        if not self._h:
+            raise RuntimeError("store closed")
         out = (ctypes.c_uint64 * 8)()
         self._lib.store_stats(self._h, out)
         return {

@@ -74,8 +74,14 @@ class Node:
             from .native_store import PoolStore, native_available
 
             if native_available():
+                from .config import RayConfig
+
                 pool_name = f"/rtpu_pool_{secrets.token_hex(4)}"
-                self._pool = PoolStore(pool_name, create=True)
+                self._pool = PoolStore(
+                    pool_name,
+                    create=True,
+                    pool_bytes=RayConfig.object_store_memory_bytes or None,
+                )
                 os.environ["RAY_TPU_POOL_NAME"] = pool_name
         except Exception:  # noqa: BLE001 - per-object segments fallback
             self._pool = None

@@ -33,6 +33,12 @@ def _untrack(shm: shared_memory.SharedMemory) -> None:
         pass
 
 
+def spill_path(spill_dir: str, object_id: ObjectID) -> str:
+    """Canonical on-disk location of a spilled object — shared by the
+    GCS spiller and the transfer plane's restore fallback."""
+    return os.path.join(spill_dir, object_id.hex() + ".bin")
+
+
 def segment_name(object_id: ObjectID) -> str:
     # Namespaced per node so two node daemons colocated on one machine
     # (tests, multi-daemon hosts) don't see each other's segments through

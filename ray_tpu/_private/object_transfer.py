@@ -74,7 +74,24 @@ class ObjectTransferServer:
             peer.reply(msg, ok=False, error=f"{type(e).__name__}: {e}")
             return
         if raw is None:
-            peer.reply(msg, ok=False, error="object not found")
+            # Restore rung: the object may have been spilled to disk on
+            # this node; serve the file so cross-node pulls of spilled
+            # objects still work (reference: spilled-object restore,
+            # local_object_manager.h:100-110).
+            import os
+
+            from .object_store import spill_path
+
+            spill_dir = os.environ.get("RAY_TPU_SPILL_DIR", "")
+            path = spill_path(spill_dir, oid) if spill_dir else ""
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(CHUNK_BYTES)
+                    size = os.path.getsize(path)
+                peer.reply(msg, ok=True, data=data, size=size)
+            except OSError:
+                peer.reply(msg, ok=False, error="object not found")
             return
         try:
             size = len(raw)
