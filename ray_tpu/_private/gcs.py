@@ -227,6 +227,24 @@ class GcsServer:
         self.head_node = head
         self.nodes[head.node_id.binary()] = head
 
+        # Control-plane fault tolerance (reference: the Redis-backed
+        # gcs store_client + NotifyGCSRestart): durable tables snapshot
+        # to the session dir and reload on head restart; daemons
+        # reconnect and re-register, actors restart from their creation
+        # specs, queued tasks re-dispatch.
+        self._version = 0
+        self._persisted_version = 0
+        self._state_path = os.path.join(session_dir, "gcs_state.pkl")
+        if os.path.exists(self._state_path):
+            try:
+                self._restore_state()
+            except Exception as e:  # noqa: BLE001 - corrupt snapshot
+                sys.stderr.write(f"gcs: state restore failed: {e}\n")
+
+        try:
+            os.unlink(address)  # stale socket from a previous head
+        except OSError:
+            pass
         self._listener = Listener(address, family="AF_UNIX", authkey=authkey)
         # Optional network control plane: remote node daemons, their
         # workers and remote drivers connect here (reference: the GCS
@@ -273,11 +291,15 @@ class GcsServer:
         self._memory_thread = threading.Thread(
             target=self._memory_loop, name="gcs-memory", daemon=True
         )
+        self._persist_thread = threading.Thread(
+            target=self._persist_loop, name="gcs-persist", daemon=True
+        )
         self._accept_thread.start()
         self._sched_thread.start()
         self._health_thread.start()
         self._spill_thread.start()
         self._memory_thread.start()
+        self._persist_thread.start()
         # Prestart a few workers so the first task doesn't pay spawn latency
         # (reference: worker_pool.cc:1323 PrestartWorkers).
         with self._lock:
@@ -341,6 +363,12 @@ class GcsServer:
             return
         try:
             handler(state, msg)
+            if mtype in self._DURABLE_TYPES:
+                # After the handler, under the lock: a snapshot taken
+                # mid-handler records the pre-bump version and will be
+                # retaken; unlocked bumps could lose increments.
+                with self._lock:
+                    self._version += 1
         except Exception as e:  # noqa: BLE001
             peer = state["peer"]
             if "req_id" in msg:
@@ -1423,8 +1451,11 @@ class GcsServer:
         plane (reference: GcsNodeManager::HandleRegisterNode)."""
         peer: PeerConn = state["peer"]
         with self._lock:
+            # Reconnecting daemons keep their node id (head restart —
+            # reference: raylets re-register after NotifyGCSRestart).
+            nid = msg.get("node_id")
             node = NodeState(
-                node_id=NodeID.from_random(),
+                node_id=NodeID(nid) if nid else NodeID.from_random(),
                 total=dict(msg["resources"]),
                 available=dict(msg["resources"]),
                 label=msg.get("label", ""),
@@ -1448,6 +1479,134 @@ class GcsServer:
             node = self.nodes.get(msg["node_id"])
             if node is not None:
                 node.last_heartbeat = time.time()
+
+    # ----------------------------------------------------------- persistence
+
+    # Message types that mutate durable state; _dispatch bumps the
+    # version so the persist loop knows to re-snapshot.
+    _DURABLE_TYPES = frozenset(
+        (
+            "kv_put", "kv_del", "register_function", "submit_task",
+            "task_done", "task_done_batch", "stream_item", "put_object",
+            "free_objects", "reserve_actor_name", "release_actor_name",
+            "actor_exit", "kill_actor", "update_refs",
+        )
+    )
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """Durable view of the GCS tables. Caller holds the lock.
+
+        Worker/node bindings are deliberately excluded: daemons
+        re-register on reconnect, actors restart from their creation
+        specs (state is lost across a head failover unless the actor
+        checkpoints — same contract the reference documents for
+        non-persistent actors)."""
+        return {
+            "kv": {ns: dict(d) for ns, d in self.kv.items()},
+            "functions": dict(self.functions),
+            "named_actors": dict(self.named_actors),
+            "actors": {
+                aid: {
+                    "spec": a.spec,
+                    "state": a.state,
+                    "name": a.name,
+                    "restarts_used": a.restarts_used,
+                    "death_reason": a.death_reason,
+                    "pending": list(a.pending),
+                }
+                for aid, a in self.actors.items()
+            },
+            "pending": list(self._pending),
+            "orphans": {
+                aid: list(specs)
+                for aid, specs in self._orphan_actor_tasks.items()
+            },
+            "objects": {
+                oid: (e.status, e.inline, e.spilled_path, e.size, e.error)
+                for oid, e in self.objects.items()
+                if e.inline is not None
+                or e.spilled_path is not None
+                or e.status == FAILED
+            },
+        }
+
+    def _persist_loop(self):
+        import pickle as _pickle
+
+        while not self._shutdown:
+            time.sleep(0.2)
+            if self._version == self._persisted_version:
+                continue
+            with self._lock:
+                version = self._version
+                snap = self._snapshot_state()
+            try:
+                blob = _pickle.dumps(snap)
+                tmp = self._state_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, self._state_path)
+                self._persisted_version = version
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"gcs: persist failed: {e}\n")
+
+    def _restore_state(self):
+        """Head restart: reload durable tables. Every restored actor
+        lost its worker with the old head — re-queue its creation spec
+        so the scheduler recreates it (and then flushes its buffered
+        method calls) once nodes re-register."""
+        import pickle as _pickle
+
+        with open(self._state_path, "rb") as f:
+            snap = _pickle.load(f)
+        self.kv = snap["kv"]
+        self.functions = snap["functions"]
+        self.named_actors = snap["named_actors"]
+        for oid, (status, inline, spilled, size, error) in snap[
+            "objects"
+        ].items():
+            e = ObjectEntry()
+            e.status = status
+            e.inline = inline
+            e.spilled_path = spilled
+            e.size = size
+            e.error = error
+            if spilled is not None:
+                # Spill files live with this head; remote clients need
+                # the node binding to route through the transfer plane.
+                e.node_id = self.head_node.node_id
+            self.objects[oid] = e
+        for spec in snap["pending"]:
+            self._pending.append(spec)
+        for aid, specs in snap["orphans"].items():
+            self._orphan_actor_tasks[aid] = list(specs)
+        for aid, rec in snap["actors"].items():
+            actor = ActorState(
+                actor_id=ActorID(aid),
+                spec=rec["spec"],
+                name=rec["name"],
+                restarts_used=rec["restarts_used"],
+            )
+            if rec["state"] == A_DEAD:
+                actor.state = A_DEAD
+                actor.death_reason = rec["death_reason"]
+            else:
+                actor.state = A_PENDING
+                for m in rec["pending"]:
+                    actor.pending.append(m)
+                if not any(
+                    s.actor_creation
+                    and s.actor_id is not None
+                    and s.actor_id.binary() == aid
+                    for s in self._pending
+                ):
+                    self._pending.append(rec["spec"])
+            self.actors[aid] = actor
+        sys.stderr.write(
+            f"gcs: restored state — {len(self.actors)} actors, "
+            f"{len(self._pending)} pending tasks, "
+            f"{sum(len(d) for d in self.kv.values())} kv keys\n"
+        )
 
     # ------------------------------------------------ memory-pressure ladder
 
@@ -1528,6 +1687,7 @@ class GcsServer:
                 return 0
             entry.spilled_path = path
             entry.segment = None
+            self._version += 1  # spilled location is durable state
         self._store.delete(ObjectID(oid))
         return n
 
@@ -1956,22 +2116,18 @@ class GcsServer:
     def _handle_worker_death(self, wid: bytes, reason: str, respawn: bool = False):
         from ..exceptions import OutOfMemoryError, WorkerCrashedError
 
-        exc_cls = (
-            OutOfMemoryError if reason.startswith("out-of-memory") else
-            WorkerCrashedError
-        )
-
         with self._lock:
             w = self.workers.get(wid)
             if w is None or w.state == W_DEAD:
                 return
             if w.death_reason_hint:
                 reason = w.death_reason_hint
-                exc_cls = (
-                    OutOfMemoryError
-                    if reason.startswith("out-of-memory")
-                    else WorkerCrashedError
-                )
+            exc_cls = (
+                OutOfMemoryError
+                if reason.startswith("out-of-memory")
+                else WorkerCrashedError
+            )
+            self._version += 1  # task failures are durable state
             prev_state = w.state
             w.state = W_DEAD
             node = self.nodes.get(w.node_id.binary())

@@ -57,16 +57,23 @@ class Node:
     """
 
     def __init__(self, resources: Dict[str, float], temp_dir: Optional[str] = None,
-                 tcp_port: Optional[int] = None):
-        base = temp_dir or os.path.join(tempfile.gettempdir(), "ray_tpu")
-        os.makedirs(base, exist_ok=True)
-        self.session_dir = os.path.join(
-            base, f"session_{int(time.time())}_{os.getpid()}_{secrets.token_hex(4)}"
-        )
+                 tcp_port: Optional[int] = None,
+                 session_dir: Optional[str] = None,
+                 authkey: Optional[bytes] = None):
+        if session_dir is None:
+            base = temp_dir or os.path.join(tempfile.gettempdir(), "ray_tpu")
+            os.makedirs(base, exist_ok=True)
+            session_dir = os.path.join(
+                base,
+                f"session_{int(time.time())}_{os.getpid()}_{secrets.token_hex(4)}",
+            )
+        # Fixed session_dir + authkey: a restarted head reuses the dir
+        # and restores its persisted GCS state from it.
+        self.session_dir = session_dir
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         # AF_UNIX socket paths are length-limited (~107 bytes); keep it short.
         self.address = os.path.join(self.session_dir, "gcs.sock")
-        self.authkey = secrets.token_bytes(16)
+        self.authkey = authkey or secrets.token_bytes(16)
         # Node-wide C++ object-store pool (plasma equivalent); workers
         # inherit the name via the environment and attach.
         self._pool = None

@@ -72,6 +72,13 @@ class PeerConn:
         if not self._reader.is_alive():
             self._reader.start()
 
+    def set_on_close(self, cb: Optional[Callable[[], None]]) -> None:
+        """Attach/replace the close handler after construction (probe
+        connections promote to long-lived ones once registered)."""
+        self._on_close = cb
+        if self._closed.is_set() and cb is not None:
+            cb()
+
     # ------------------------------------------------------------------ send
 
     def send(self, msg: Any) -> None:

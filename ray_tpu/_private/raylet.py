@@ -54,6 +54,7 @@ class NodeDaemon:
         self._workers: Dict[bytes, subprocess.Popen] = {}
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        self._rejoining = False
 
         # Node-local object pool: our own namespace + pool, inherited by
         # the workers we spawn. Set BEFORE the store/transfer server are
@@ -172,12 +173,74 @@ class NodeDaemon:
                     {"type": "node_heartbeat", "node_id": self.node_id}
                 )
             except ConnectionLost:
-                return
+                # Head may be restarting. The conn's own on_close drives
+                # the rejoin; calling it here too is safe (reentrancy
+                # guard) and covers a conn that died before its handler
+                # was attached.
+                self._on_gcs_close()
+                continue
 
     def _on_gcs_close(self):
-        # Head died or network partition: this node is orphaned; take the
-        # workers down with us (reference: raylet exits when GCS
-        # connection is lost and no NotifyGCSRestart arrives).
+        # Head died (restarting) or network partition. Take the workers
+        # down — their control conns died with the head — but keep the
+        # daemon alive and try to rejoin a restarted head for a grace
+        # window before giving up (reference: raylets re-register after
+        # NotifyGCSRestart; exit only when no restart arrives).
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            # One rejoin loop at a time: every closed conn (including
+            # failed probes) fires its on_close on its own reader
+            # thread; re-entering would race re-registration or exit a
+            # daemon that already rejoined.
+            if self._rejoining:
+                return
+            self._rejoining = True
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for proc in workers:
+            proc.terminate()
+        try:
+            deadline = time.time() + RayConfig.worker_register_timeout_s
+            while time.time() < deadline and not self._shutdown.is_set():
+                time.sleep(0.5)
+                try:
+                    raw = transport.connect(self.gcs_address, self.authkey)
+                except OSError:
+                    continue
+                # Probe conns carry no on_close; only a conn we promote
+                # to self.conn gets the reconnect handler.
+                conn = PeerConn(
+                    raw,
+                    push_handler=self._on_push,
+                    name="raylet",
+                )
+                try:
+                    reply = conn.request(
+                        {
+                            "type": "register_node",
+                            "node_id": self.node_id,
+                            "resources": self.resources,
+                            "transfer_addr": self.transfer.address,
+                            "label": self.label or os.uname().nodename,
+                            "pid": os.getpid(),
+                        },
+                        timeout=RayConfig.worker_register_timeout_s,
+                    )
+                except (ConnectionLost, TimeoutError, OSError):
+                    conn.close()
+                    continue
+                if reply.get("ok"):
+                    self.conn = conn
+                    conn.set_on_close(self._on_gcs_close)
+                    sys.stderr.write(
+                        f"raylet {self.node_id.hex()[:8]}: rejoined head\n"
+                    )
+                    return
+                conn.close()
+        finally:
+            with self._lock:
+                self._rejoining = False
         if not self._shutdown.is_set():
             self.shutdown()
             os._exit(0)

@@ -1,0 +1,176 @@
+"""GCS persistence + head restart recovery.
+
+Reference behavior: the Redis-backed gcs store_client
+(src/ray/gcs/store_client/redis_store_client.h) and
+NotifyGCSRestart (src/ray/raylet/node_manager.h:614): kill the head,
+restart it on the same endpoint, and the cluster recovers — daemons
+rejoin, named/detached actors restart from their creation specs, KV
+survives, and tasks queued at the old head complete.
+"""
+import os
+import secrets
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_head(session_dir: str, port: int, authkey: str) -> subprocess.Popen:
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.head_main",
+            "--session-dir", session_dir,
+            "--tcp-port", str(port),
+            "--authkey", authkey,
+            "--num-cpus", "0",
+        ],
+        env={**os.environ, "PYTHONPATH": REPO},
+        stderr=subprocess.PIPE,
+    )
+    # Wait for the listening line.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stderr.readline().decode(errors="replace")
+        if "head up" in line:
+            return proc
+        if proc.poll() is not None:
+            raise RuntimeError(f"head exited: {proc.stderr.read().decode()}")
+    raise TimeoutError("head did not come up")
+
+
+def _spawn_raylet(address: str, authkey: str, resources: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu._private.raylet",
+            "--address", address,
+            "--authkey", authkey,
+            "--resources", resources,
+            "--transfer-host", "127.0.0.1",
+        ],
+        env={**os.environ, "PYTHONPATH": REPO},
+        stderr=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL,
+    )
+
+
+def _run_driver(code: str, address: str) -> str:
+    out = subprocess.run(
+        [sys.executable, "-c", code, address],
+        env={**os.environ, "PYTHONPATH": REPO},
+        capture_output=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr.decode(errors="replace")
+    return out.stdout.decode(errors="replace")
+
+
+PHASE1 = """
+import sys, time
+import ray_tpu
+
+ray_tpu.init(address=sys.argv[1])
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def mark(self, key):
+        import ray_tpu as rt
+        from ray_tpu._private.worker import global_client
+        self.n += 1
+        global_client().kv_put(key.encode(), str(self.n).encode())
+        return self.n
+
+# Detached + named + restartable: survives this driver, restarts after
+# head failover, and its method calls route via the GCS (so they queue
+# head-side while the actor is still pending on the 'late' resource).
+c = Counter.options(
+    name="survivor", lifetime="detached", max_restarts=3,
+    resources={"late": 1},
+).remote()
+c.mark.remote("queued_marker")
+time.sleep(1.0)  # let the buffered call + creation spec land in the GCS
+from ray_tpu._private.worker import global_client
+global_client().kv_put(b"phase1", b"done")
+time.sleep(0.5)  # persist tick
+print("PHASE1-OK")
+"""
+
+PHASE2 = """
+import sys, time
+import ray_tpu
+from ray_tpu._private.worker import global_client
+
+ray_tpu.init(address=sys.argv[1])
+client = global_client()
+assert client.kv_get(b"phase1") == b"done", "kv lost across restart"
+
+# Named actor resolves after head restart.
+c = ray_tpu.get_actor("survivor")
+
+# The task queued at the OLD head completed after failover.
+deadline = time.time() + 60
+val = None
+while time.time() < deadline:
+    val = client.kv_get(b"queued_marker")
+    if val is not None:
+        break
+    time.sleep(0.5)
+assert val is not None, "queued task never completed after head restart"
+
+# And the restarted actor serves new calls.
+n = ray_tpu.get(c.mark.remote("post_restart"), timeout=60)
+assert n >= 1
+print("PHASE2-OK", val.decode(), n)
+"""
+
+
+def test_head_restart_recovers_state(tmp_path):
+    session_dir = str(tmp_path / "headsess")
+    port = _free_port()
+    authkey = secrets.token_bytes(16).hex()
+    address = f"127.0.0.1:{port}?{authkey}"
+
+    head = _spawn_head(session_dir, port, authkey)
+    raylet1 = _spawn_raylet(f"127.0.0.1:{port}", authkey, '{"CPU": 2}')
+    try:
+        time.sleep(1.0)
+        assert "PHASE1-OK" in _run_driver(PHASE1, address)
+
+        # SIGKILL the head mid-session: the actor is still PENDING on
+        # the missing 'late' resource, its first call queued head-side.
+        head.kill()
+        head.wait(timeout=10)
+        time.sleep(0.5)
+
+        head = _spawn_head(session_dir, port, authkey)
+
+        # The surviving raylet rejoins; a new node brings the 'late'
+        # resource so the detached actor can finally schedule.
+        raylet2 = _spawn_raylet(
+            f"127.0.0.1:{port}", authkey, '{"CPU": 1, "late": 1}'
+        )
+        try:
+            out = _run_driver(PHASE2, address)
+            assert "PHASE2-OK" in out
+        finally:
+            raylet2.kill()
+    finally:
+        for p in (raylet1, head):
+            try:
+                p.kill()
+            except Exception:
+                pass
