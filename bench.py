@@ -98,6 +98,22 @@ def main() -> int:
     )
 
     extra = {}
+    if os.environ.get("BENCH_LONGCTX", "1") != "0":
+        # Long-context entry: same model, 4x the sequence at batch 1 —
+        # the regime the pallas flash fwd+bwd kernels exist for (the
+        # score matrix at s8192 would be 256 MiB/head/layer in f32 if
+        # materialized; blockwise fwd+bwd never leaves VMEM).
+        lc_seq = int(os.environ.get("BENCH_LONGCTX_SEQ", "8192"))
+        lc_tok, lc_mfu, lc_loss = bench_model(
+            LlamaForCausalLM(cfg), cfg, cfg.num_params(), 1, lc_seq,
+            max(5, steps // 2), peak_flops,
+        )
+        extra.update(
+            longctx_seq=lc_seq,
+            longctx_tokens_per_s=round(lc_tok, 1),
+            longctx_mfu=round(lc_mfu, 3),
+            longctx_loss=round(lc_loss, 3),
+        )
     if run_moe:
         from ray_tpu.models.mixtral import CONFIGS as MOE_CONFIGS
         from ray_tpu.models.mixtral import MixtralForCausalLM
@@ -114,12 +130,12 @@ def main() -> int:
             steps,
             peak_flops,
         )
-        extra = {
-            "moe_model": "mixtral-small (8 experts, top-2)",
-            "moe_tokens_per_s": round(moe_tok, 1),
-            "moe_mfu_active": round(moe_mfu, 3),
-            "moe_loss": round(moe_loss, 3),
-        }
+        extra.update(
+            moe_model="mixtral-small (8 experts, top-2)",
+            moe_tokens_per_s=round(moe_tok, 1),
+            moe_mfu_active=round(moe_mfu, 3),
+            moe_loss=round(moe_loss, 3),
+        )
 
     print(
         json.dumps(

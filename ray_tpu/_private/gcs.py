@@ -346,7 +346,12 @@ class GcsServer:
             self._handle_worker_death(wid, "worker connection closed")
         nid = state.get("node_id")
         if nid is not None and state.get("role") in ("raylet", "driver"):
-            self._handle_node_death(nid, "node daemon connection closed")
+            # Identity check: a daemon that already re-registered (head
+            # restart, asymmetric conn failure) has a fresh NodeState
+            # with a new conn — the STALE conn's close must not kill it.
+            node = self.nodes.get(nid)
+            if node is None or node.conn is state.get("peer") or node.conn is None:
+                self._handle_node_death(nid, "node daemon connection closed")
 
     # ---------------------------------------------------------------- dispatch
 
@@ -1466,6 +1471,10 @@ class GcsServer:
             self.nodes[node.node_id.binary()] = node
             state["role"] = "raylet"
             state["node_id"] = node.node_id.binary()
+            # Restored placement groups re-reserve as capacity returns.
+            for pg in self.placement_groups.values():
+                if pg.state == "PENDING" and self._try_reserve_pg(pg)[0]:
+                    pg.state = "CREATED"
             self._work.notify_all()
         peer.reply(
             msg,
@@ -1490,6 +1499,7 @@ class GcsServer:
             "task_done", "task_done_batch", "stream_item", "put_object",
             "free_objects", "reserve_actor_name", "release_actor_name",
             "actor_exit", "kill_actor", "update_refs",
+            "create_placement_group", "remove_placement_group",
         )
     )
 
@@ -1521,6 +1531,19 @@ class GcsServer:
                 aid: list(specs)
                 for aid, specs in self._orphan_actor_tasks.items()
             },
+            # Bundle reservations are node-bound and die with the old
+            # head's node table; persist the PG definitions and restore
+            # them PENDING so the reservation loop re-places them on the
+            # re-registered nodes.
+            "placement_groups": {
+                pid: {
+                    "bundles": [dict(b.resources) for b in pg.bundles],
+                    "strategy": pg.strategy,
+                    "state": pg.state,
+                    "name": pg.name,
+                }
+                for pid, pg in self.placement_groups.items()
+            },
             "objects": {
                 oid: (e.status, e.inline, e.spilled_path, e.size, e.error)
                 for oid, e in self.objects.items()
@@ -1547,6 +1570,8 @@ class GcsServer:
                     f.write(blob)
                 os.replace(tmp, self._state_path)
                 self._persisted_version = version
+            except FileNotFoundError:
+                return  # session dir removed: shutting down
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(f"gcs: persist failed: {e}\n")
 
@@ -1580,6 +1605,19 @@ class GcsServer:
             self._pending.append(spec)
         for aid, specs in snap["orphans"].items():
             self._orphan_actor_tasks[aid] = list(specs)
+        for pid, rec in snap.get("placement_groups", {}).items():
+            if rec["state"] == "REMOVED":
+                continue
+            self.placement_groups[pid] = PlacementGroupState(
+                pg_id=PlacementGroupID(pid),
+                bundles=[
+                    BundleState(resources=dict(b), available=dict(b))
+                    for b in rec["bundles"]
+                ],
+                strategy=rec["strategy"],
+                state="PENDING",  # re-reserved as nodes re-register
+                name=rec["name"],
+            )
         for aid, rec in snap["actors"].items():
             actor = ActorState(
                 actor_id=ActorID(aid),
@@ -1587,10 +1625,30 @@ class GcsServer:
                 name=rec["name"],
                 restarts_used=rec["restarts_used"],
             )
+            spec: TaskSpec = rec["spec"]
+            detached = spec.lifetime == "detached"
+            was_scheduled = rec["state"] not in (A_PENDING,)
             if rec["state"] == A_DEAD:
                 actor.state = A_DEAD
                 actor.death_reason = rec["death_reason"]
+            elif (
+                was_scheduled
+                and not detached
+                and actor.restarts_used >= spec.max_restarts
+            ):
+                # The worker died with the old head; recreating would
+                # break at-most-once semantics for non-restartable,
+                # non-detached actors (same limit _handle_worker_death
+                # enforces).
+                actor.state = A_DEAD
+                actor.death_reason = (
+                    "actor lost in head failover (max_restarts exhausted)"
+                )
+                if actor.name:
+                    self.named_actors.pop(actor.name, None)
             else:
+                if was_scheduled and not detached:
+                    actor.restarts_used += 1
                 actor.state = A_PENDING
                 for m in rec["pending"]:
                     actor.pending.append(m)
@@ -1600,7 +1658,7 @@ class GcsServer:
                     and s.actor_id.binary() == aid
                     for s in self._pending
                 ):
-                    self._pending.append(rec["spec"])
+                    self._pending.append(spec)
             self.actors[aid] = actor
         sys.stderr.write(
             f"gcs: restored state — {len(self.actors)} actors, "
@@ -1932,8 +1990,12 @@ class GcsServer:
         res = self._task_resources(spec)
         if spec.placement_group_id is not None:
             pg = self.placement_groups.get(spec.placement_group_id.binary())
-            if pg is None or pg.state != "CREATED":
+            if pg is None or pg.state == "REMOVED":
                 raise _Unschedulable("placement group removed or not found")
+            if pg.state != "CREATED":
+                # Restoring after a head failover: bundles re-reserve as
+                # nodes re-register; hold the task, don't fail it.
+                return None
             idx = spec.placement_group_bundle_index
             if idx >= len(pg.bundles):
                 raise _Unschedulable(

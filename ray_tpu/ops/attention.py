@@ -165,6 +165,183 @@ def _flash_fwd_pallas(q, k, v, *, causal, sm_scale, block_q, block_k):
     return o[:, :tq], lse[:, :tq, 0]
 
 
+# ----------------------------------------------------------------- pallas bwd
+# FlashAttention-2 style backward: probabilities recomputed per block
+# from the saved log-sum-exp, two kernels so each output accumulates in
+# VMEM over its contraction dimension (dk/dv over q blocks, dq over kv
+# blocks) and the [Tq, Tk] score matrix never hits HBM.
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, sm_scale: float, causal: bool, block_q: int,
+                    block_k: int, seq_k: int):
+    ik, jq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]  # [block_q, d]
+    k = k_ref[0]  # [block_k, d]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # [block_q, 1]
+    delta = delta_ref[0]  # [block_q, 1]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    kpos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = kpos < seq_k
+    if causal:
+        qpos = jq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = jnp.logical_and(mask, qpos >= kpos)
+    s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse)  # [block_q, block_k]
+
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * sm_scale
+    dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+        ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(jq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, sm_scale: float, causal: bool, block_q: int,
+                   block_k: int, seq_k: int):
+    iq, jk = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    kpos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = kpos < seq_k
+    if causal:
+        qpos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = jnp.logical_and(mask, qpos >= kpos)
+    s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * sm_scale
+    dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+        ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(jk == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, *, causal, sm_scale,
+                      block_q, block_k):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    tq_p = (tq + block_q - 1) // block_q * block_q
+    tk_p = (tk + block_k - 1) // block_k * block_k
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # [bh, tq]
+    if tq_p != tq:
+        pad = ((0, 0), (0, tq_p - tq), (0, 0))
+        q = jnp.pad(q, pad)
+        do = jnp.pad(do, pad)
+        lse = jnp.pad(lse, ((0, 0), (0, tq_p - tq)))
+        delta = jnp.pad(delta, ((0, 0), (0, tq_p - tq)))
+    if tk_p != tk:
+        pad = ((0, 0), (0, tk_p - tk), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    kv_spec_i = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_k=tk,
+        ),
+        grid=(bh, tk_p // block_k, tq_p // block_q),
+        in_specs=[q_spec, kv_spec_i, kv_spec_i, q_spec, row_spec, row_spec],
+        out_specs=[kv_spec_i, kv_spec_i],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=5 * bh * tq_p * tk_p * d,
+            bytes_accessed=(q.size + k.size + v.size + do.size) * 2,
+            transcendentals=bh * tq_p * tk_p,
+        ),
+    )(q, k, v, do, lse3, delta3)
+
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec_j = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_k=tk,
+        ),
+        grid=(bh, tq_p // block_q, tk_p // block_k),
+        in_specs=[q_spec2, kv_spec_j, kv_spec_j, q_spec2, row_spec2, row_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=5 * bh * tq_p * tk_p * d,
+            bytes_accessed=(q.size + k.size + v.size + do.size) * 2,
+            transcendentals=bh * tq_p * tk_p,
+        ),
+    )(q, k, v, do, lse3, delta3)
+    return dq[:, :tq], dk[:, :tk], dv[:, :tk]
+
+
 # ------------------------------------------------------------------ custom vjp
 
 
@@ -189,10 +366,15 @@ def _flash_fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
-    # Recompute probabilities from lse: p = exp(s - lse). XLA keeps this
-    # fused; memory high-water is the [Tq, Tk] block per batch*head slice,
-    # acceptable at bench sequence lengths (ring attention bounds it for
-    # long context).
+    tq, tk = q.shape[1], k.shape[1]
+    if _on_tpu() and tq >= 128 and tk >= 128 and q.shape[2] % 8 == 0:
+        return _flash_bwd_pallas(
+            q, k, v, o, lse, do, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k,
+        )
+    # XLA fallback: recompute probabilities from lse, p = exp(s - lse).
+    # Memory high-water is the [Tq, Tk] block per batch*head slice —
+    # fine at short seq, the pallas kernels carry long context.
     s = jax.lax.dot_general(
         q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
     ) * sm_scale

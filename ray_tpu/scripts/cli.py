@@ -225,6 +225,32 @@ def cmd_jobs(args):
     _print_table(client.list_jobs(), ["job_id", "status", "entrypoint"])
 
 
+def cmd_serve_deploy(args):
+    """Declarative deploy (reference: `serve deploy config.yaml`)."""
+    import os
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    sys.path.insert(0, os.getcwd())
+    ray_tpu.init(address=args.address or "auto", ignore_reinit_error=True)
+    handles = serve.deploy_config(args.config)
+    print(f"deployed {len(handles)} application(s) from {args.config}")
+
+
+def cmd_serve_status(args):
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(address=args.address or "auto", ignore_reinit_error=True)
+    for name, info in serve.status().items():
+        deps = ", ".join(
+            f"{d}: {s.status.value} x{s.num_replicas}"
+            for d, s in info.deployments.items()
+        )
+        print(f"{name}: {info.status.value}  [{deps}]")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -282,6 +308,16 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_submit)
 
     sub.add_parser("jobs", help="list jobs").set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("serve", help="serve control (deploy/status)")
+    serve_sub = sp.add_subparsers(dest="serve_cmd", required=True)
+    spd = serve_sub.add_parser("deploy", help="deploy a YAML config")
+    spd.add_argument("config", help="path to serve config YAML")
+    spd.add_argument("--address", default=None, help="cluster address")
+    spd.set_defaults(fn=cmd_serve_deploy)
+    sps = serve_sub.add_parser("status", help="application statuses")
+    sps.add_argument("--address", default=None, help="cluster address")
+    sps.set_defaults(fn=cmd_serve_status)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -31,8 +31,13 @@ from .api import (  # noqa: F401
     status,
 )
 from .batching import batch  # noqa: F401
-from .config import AutoscalingConfig, HTTPOptions  # noqa: F401
-from .handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from .config import AutoscalingConfig, GRPCOptions, HTTPOptions  # noqa: F401
+from .handle import (  # noqa: F401
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
+from .schema import deploy_config  # noqa: F401
 
 __all__ = [
     "Application",
@@ -40,7 +45,10 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "DeploymentResponseGenerator",
+    "deploy_config",
     "HTTPOptions",
+    "GRPCOptions",
     "batch",
     "delete",
     "deployment",

@@ -102,6 +102,18 @@ class ApplicationStateManager:
         if routes != self._last_routes:
             self._last_routes = routes
             self._long_poll.notify_changed({LongPollKey.ROUTE_TABLE: routes})
+        apps = {
+            app.name: {
+                "app_name": app.name,
+                "ingress": app.ingress,
+                "streaming": app.ingress_streaming,
+            }
+            for app in self._apps.values()
+            if not app.deleting
+        }
+        if apps != getattr(self, "_last_grpc_apps", None):
+            self._last_grpc_apps = apps
+            self._long_poll.notify_changed({LongPollKey.GRPC_APPS: apps})
 
     def status(self, name: str) -> Optional[ApplicationStatusInfo]:
         app = self._apps.get(name)

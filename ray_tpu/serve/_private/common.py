@@ -88,6 +88,9 @@ class LongPollKey:
         return f"RUNNING_REPLICAS::{dep_id}"
 
     ROUTE_TABLE = "ROUTE_TABLE"
+    # All apps keyed by name (gRPC routes by application, not prefix —
+    # apps with route_prefix=None are still gRPC-reachable).
+    GRPC_APPS = "GRPC_APPS"
 
 
 @dataclass

@@ -23,7 +23,7 @@ from ._private.common import (
     PROXY_NAME_PREFIX,
 )
 from ._private.replica import get_replica_context  # noqa: F401 (re-export)
-from .config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from .config import AutoscalingConfig, DeploymentConfig, GRPCOptions, HTTPOptions
 from .handle import DeploymentHandle
 
 
@@ -142,8 +142,9 @@ def _get_controller():
     return get_actor(CONTROLLER_NAME)
 
 
-def start(http_options: Optional[HTTPOptions] = None, proxy: bool = True):
-    """Ensure the controller (and HTTP proxy) are running."""
+def start(http_options: Optional[HTTPOptions] = None, proxy: bool = True,
+          grpc_options: Optional["GRPCOptions"] = None):
+    """Ensure the controller (and HTTP/gRPC proxies) are running."""
     from .. import get, get_actor, is_initialized, init, remote
 
     if not is_initialized():
@@ -174,6 +175,19 @@ def start(http_options: Optional[HTTPOptions] = None, proxy: bool = True):
             .remote(http_options.host, http_options.port)
         )
         get(proxy_actor.ready.remote())
+    if grpc_options is not None:
+        from ._private.grpc_proxy import GrpcProxyActor
+
+        grpc_actor = (
+            remote(GrpcProxyActor)
+            .options(
+                name=f"{PROXY_NAME_PREFIX}::grpc",
+                max_concurrency=256,
+                get_if_exists=True,
+            )
+            .remote(grpc_options.host, grpc_options.port)
+        )
+        get(grpc_actor.ready.remote())
     return controller
 
 
@@ -219,9 +233,12 @@ def run(
     route_prefix: Optional[str] = "/",
     _blocking: bool = True,
     timeout_s: float = 120.0,
+    deployment_overrides: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> DeploymentHandle:
     """Deploy an application; returns a handle to its ingress
-    (reference serve/api.py:570)."""
+    (reference serve/api.py:570). ``deployment_overrides`` maps
+    deployment name -> config-field updates (the declarative-config
+    path: YAML values override code-side settings, serve/schema.py)."""
     from .. import get
 
     if not isinstance(target, Application):
@@ -230,6 +247,20 @@ def run(
     infos: Dict[str, dict] = {}
     handles: Dict[int, DeploymentHandle] = {}
     ingress_name = _flatten_application(target, infos, handles, name)
+    for dep_name, updates in (deployment_overrides or {}).items():
+        if dep_name not in infos:
+            raise ValueError(
+                f"deployment override for unknown deployment {dep_name!r}; "
+                f"application has {sorted(infos)}"
+            )
+        updates = dict(updates)
+        if isinstance(updates.get("autoscaling_config"), dict):
+            updates["autoscaling_config"] = AutoscalingConfig(
+                **updates["autoscaling_config"]
+            )
+        infos[dep_name]["config"] = _dc_replace(
+            infos[dep_name]["config"], **updates
+        )
     payload = [
         {k: v for k, v in d.items() if k != "_app_obj_id"} for d in infos.values()
     ]

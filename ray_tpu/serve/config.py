@@ -43,6 +43,15 @@ class HTTPOptions:
 
 
 @dataclass
+class GRPCOptions:
+    """gRPC ingress (reference: serve gRPCOptions — grpc_servicer_
+    functions there; schema-free generic service here)."""
+
+    host: str = "127.0.0.1"
+    port: int = 9000
+
+
+@dataclass
 class DeploymentConfig:
     """Per-deployment runtime knobs (reference:
     serve/_private/config.py DeploymentConfig)."""
