@@ -294,6 +294,18 @@ class GcsServer:
         self._persist_thread = threading.Thread(
             target=self._persist_loop, name="gcs-persist", daemon=True
         )
+        # Log pipeline (reference: _private/log_monitor.py +
+        # ray_logging dedup): tail this node's worker logs, keep a
+        # bounded ring for `ray-tpu logs`, push to subscribed drivers.
+        from .log_monitor import LogDeduplicator, LogMonitor
+
+        self.log_buffer: deque = deque(maxlen=10_000)
+        self._log_subscribers: List[PeerConn] = []
+        self._log_dedup = LogDeduplicator()
+        self._log_monitor = LogMonitor(
+            os.path.join(session_dir, "logs"),
+            lambda entries: self._ingest_logs("head", entries),
+        )
         self._accept_thread.start()
         self._sched_thread.start()
         self._health_thread.start()
@@ -1666,6 +1678,60 @@ class GcsServer:
             f"{sum(len(d) for d in self.kv.values())} kv keys\n"
         )
 
+    # ------------------------------------------------------------ log pipeline
+
+    def _ingest_logs(self, node_label: str, entries) -> None:
+        """entries: [(worker_tag, line)] from a node's LogMonitor."""
+        tagged = [(node_label, w, line) for w, line in entries]
+        with self._lock:
+            # Dedup state is shared across the head monitor thread and
+            # raylet log_batch handler threads.
+            emit = self._log_dedup.filter(tagged)
+            if not emit:
+                return
+            self.log_buffer.extend(emit)
+            subs = list(self._log_subscribers)
+        self._push_log_lines(emit, subs)
+
+    def _push_log_lines(self, emit, subs) -> None:
+        msg = {"type": "log_lines", "entries": emit}
+        for peer in subs:
+            try:
+                peer.send(msg)
+            except ConnectionLost:
+                with self._lock:
+                    if peer in self._log_subscribers:
+                        self._log_subscribers.remove(peer)
+
+    def _flush_log_repeats(self) -> None:
+        """Periodic (health loop): emit '[repeated Nx]' summaries for
+        lines suppressed inside the dedup window."""
+        with self._lock:
+            emit = self._log_dedup.flush_repeats()
+            if not emit:
+                return
+            self.log_buffer.extend(emit)
+            subs = list(self._log_subscribers)
+        self._push_log_lines(emit, subs)
+
+    def _h_log_batch(self, state, msg):
+        # A raylet's monitor shipping its node's worker lines.
+        self._ingest_logs(msg.get("node", "?"), msg["entries"])
+
+    def _h_subscribe_logs(self, state, msg):
+        with self._lock:
+            self._log_subscribers.append(state["peer"])
+        state["peer"].reply(msg, ok=True)
+
+    def _h_get_logs(self, state, msg):
+        prefix = msg.get("worker_prefix") or ""
+        n = msg.get("tail", 1000)
+        with self._lock:
+            lines = [
+                e for e in self.log_buffer if e[1].startswith(prefix)
+            ][-n:]
+        state["peer"].reply(msg, ok=True, lines=lines)
+
     # ------------------------------------------------ memory-pressure ladder
 
     def _spill_loop(self):
@@ -1841,6 +1907,7 @@ class GcsServer:
         threshold = RayConfig.health_check_failure_threshold
         while not self._shutdown:
             time.sleep(period)
+            self._flush_log_repeats()
             now = time.time()
             with self._lock:
                 stale = [
@@ -2152,6 +2219,7 @@ class GcsServer:
         env["RAY_TPU_SESSION_ADDR"] = self.address
         env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
         env["RAY_TPU_WORKER_ID"] = wid.hex()
+        env["PYTHONUNBUFFERED"] = "1"  # prints reach the log tailer live
         if not tpu:
             # Pin non-TPU workers to CPU: strip accelerator-plugin hooks
             # (this box's sitecustomize force-registers the TPU backend when
@@ -2258,6 +2326,7 @@ class GcsServer:
     # --------------------------------------------------------------- shutdown
 
     def shutdown(self):
+        self._log_monitor.stop()
         with self._lock:
             self._shutdown = True
             self._work.notify_all()

@@ -104,6 +104,30 @@ class NodeDaemon:
             target=self._heartbeat_loop, name="raylet-heartbeat", daemon=True
         )
         self._hb_thread.start()
+        # This daemon's workers log into a raylet-owned local dir (NOT
+        # the head's session dir — on a shared box the head's monitor
+        # would double-ship every line; on a real remote machine the
+        # head can't see the files at all). One monitor tails it and
+        # ships batches over the control plane.
+        from .log_monitor import LogMonitor
+
+        self.logs_dir = os.path.join(
+            "/tmp", "ray_tpu_logs", self.node_ns.rstrip("_")
+        )
+        os.makedirs(self.logs_dir, exist_ok=True)
+        self._log_monitor = LogMonitor(self.logs_dir, self._publish_logs)
+
+    def _publish_logs(self, entries):
+        try:
+            self.conn.send(
+                {
+                    "type": "log_batch",
+                    "node": self.label or f"node-{self.node_id.hex()[:6]}",
+                    "entries": entries,
+                }
+            )
+        except ConnectionLost:
+            pass
 
     # --------------------------------------------------------------- pushes
 
@@ -131,6 +155,7 @@ class NodeDaemon:
         env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
         env["RAY_TPU_WORKER_ID"] = wid.hex()
         env["RAY_TPU_NODE_NS"] = self.node_ns
+        env["PYTHONUNBUFFERED"] = "1"  # prints reach the log tailer live
         if not msg.get("tpu"):
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env["JAX_PLATFORMS"] = "cpu"
@@ -138,15 +163,8 @@ class NodeDaemon:
         env["PYTHONPATH"] = (
             os.getcwd() + os.pathsep + sys.path[0] + os.pathsep + env["PYTHONPATH"]
         )
-        logdir = os.path.join(self.session_dir, "logs")
-        try:
-            os.makedirs(logdir, exist_ok=True)
-            out = open(os.path.join(logdir, f"worker-{wid.hex()[:8]}.out"), "ab")
-        except OSError:
-            # Remote machine: session dir may not exist here; use local tmp.
-            logdir = os.path.join("/tmp", "ray_tpu_logs")
-            os.makedirs(logdir, exist_ok=True)
-            out = open(os.path.join(logdir, f"worker-{wid.hex()[:8]}.out"), "ab")
+        os.makedirs(self.logs_dir, exist_ok=True)
+        out = open(os.path.join(self.logs_dir, f"worker-{wid.hex()[:8]}.out"), "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main"],
             env=env,
@@ -249,6 +267,8 @@ class NodeDaemon:
         if self._shutdown.is_set():
             return
         self._shutdown.set()
+        if getattr(self, "_log_monitor", None) is not None:
+            self._log_monitor.stop()
         with self._lock:
             workers = list(self._workers.values())
             self._workers.clear()

@@ -7,6 +7,7 @@ points (worker.py:1225,2539,2679,2744).
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -126,11 +127,30 @@ def init(
                 )
                 transfer_addr = _global.transfer.address
         _global.client = CoreClient(
-            address_, authkey, role=DRIVER_MODE, transfer_addr=transfer_addr
+            address_, authkey, role=DRIVER_MODE, transfer_addr=transfer_addr,
+            push_handler=_driver_push,
         )
         _global.mode = DRIVER_MODE
+        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            # Worker stdout/stderr stream to this driver (reference:
+            # log_monitor shipping lines to the driver's console).
+            try:
+                _global.client.request({"type": "subscribe_logs"}, timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
         atexit.register(_atexit_shutdown)
         return _global.client
+
+
+def _driver_push(msg):
+    if msg.get("type") == "log_lines":
+        import sys as _sys
+
+        for node, worker_tag, line in msg["entries"]:
+            print(
+                f"({node} worker={worker_tag}) {line}",
+                file=_sys.stdout, flush=True,
+            )
 
 
 def connect_existing(client: CoreClient, mode: str):

@@ -225,6 +225,29 @@ def cmd_jobs(args):
     _print_table(client.list_jobs(), ["job_id", "status", "entrypoint"])
 
 
+def cmd_logs(args):
+    """Recent worker stdout/stderr from the cluster's log ring
+    (reference: `ray logs`)."""
+    import os
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_client
+
+    # No live log subscription: the ring snapshot below would duplicate
+    # every line that also arrived as a push.
+    os.environ["RAY_TPU_LOG_TO_DRIVER"] = "0"
+    ray_tpu.init(address=args.address or "auto", ignore_reinit_error=True)
+    reply = global_client().request(
+        {
+            "type": "get_logs",
+            "worker_prefix": args.worker or "",
+            "tail": args.tail,
+        }
+    )
+    for node, worker_tag, line in reply.get("lines", []):
+        print(f"({node} worker={worker_tag}) {line}")
+
+
 def cmd_serve_deploy(args):
     """Declarative deploy (reference: `serve deploy config.yaml`)."""
     import os
@@ -233,15 +256,19 @@ def cmd_serve_deploy(args):
     from ray_tpu import serve
 
     sys.path.insert(0, os.getcwd())
+    os.environ["RAY_TPU_LOG_TO_DRIVER"] = "0"
     ray_tpu.init(address=args.address or "auto", ignore_reinit_error=True)
     handles = serve.deploy_config(args.config)
     print(f"deployed {len(handles)} application(s) from {args.config}")
 
 
 def cmd_serve_status(args):
+    import os
+
     import ray_tpu
     from ray_tpu import serve
 
+    os.environ["RAY_TPU_LOG_TO_DRIVER"] = "0"
     ray_tpu.init(address=args.address or "auto", ignore_reinit_error=True)
     for name, info in serve.status().items():
         deps = ", ".join(
@@ -308,6 +335,12 @@ def main(argv=None):
     sp.set_defaults(fn=cmd_submit)
 
     sub.add_parser("jobs", help="list jobs").set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser("logs", help="recent worker logs")
+    sp.add_argument("--worker", default=None, help="worker id prefix filter")
+    sp.add_argument("--tail", type=int, default=1000)
+    sp.add_argument("--address", default=None, help="cluster address")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("serve", help="serve control (deploy/status)")
     serve_sub = sp.add_subparsers(dest="serve_cmd", required=True)

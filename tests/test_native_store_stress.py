@@ -1,0 +1,52 @@
+"""Multi-process crash/stress coverage for the C++ pool store.
+
+Reference behavior: the plasma store's ASAN/TSAN CI (.bazelrc:104-126)
+and crash-resilience — a client SIGKILLed mid-operation (possibly
+holding the process-shared robust mutex) must not corrupt or deadlock
+the pool. The heavy loop lives in native/stress_main.cpp; `make
+stress-asan && store_stress_asan 100 4` is the full sanitizer run
+(passes 100 rounds); this test builds and runs a bounded slice.
+"""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+BUILD = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)),
+    "ray_tpu", "_private", "_native",
+)
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+def _build(target: str, binary: str) -> str:
+    subprocess.run(
+        ["make", target], cwd=NATIVE, check=True, capture_output=True
+    )
+    path = os.path.join(BUILD, binary)
+    assert os.path.exists(path)
+    return path
+
+
+def test_stress_survives_sigkill_mid_operation():
+    path = _build("stress", "store_stress")
+    out = subprocess.run(
+        [path, "10", "4"], capture_output=True, timeout=300
+    )
+    assert out.returncode == 0, out.stderr.decode()
+    assert b"stress OK" in out.stdout
+
+
+def test_stress_asan_clean():
+    path = _build("stress-asan", "store_stress_asan")
+    out = subprocess.run(
+        [path, "10", "4"], capture_output=True, timeout=600
+    )
+    assert out.returncode == 0, out.stderr.decode()
+    assert b"AddressSanitizer" not in out.stderr
+    assert b"stress OK" in out.stdout
