@@ -87,6 +87,8 @@ static int reader_proc(const char* pool, int writers, int ops) {
 int main(int argc, char** argv) {
   int rounds = argc > 1 ? atoi(argv[1]) : 5;
   int writers = argc > 2 ? atoi(argv[2]) : 4;
+  if (writers < 1) writers = 1;
+  if (writers > 24) writers = 24;  // pids[] holds 2*writers entries
   char pool[64];
   snprintf(pool, sizeof(pool), "/rtpu_stress_%d", (int)getpid());
 

@@ -57,6 +57,13 @@ class CoreClient:
             hello["transfer_addr"] = transfer_addr
         if direct_addr:
             hello["direct_addr"] = direct_addr
+        nid_hex = os.environ.get("RAY_TPU_NODE_ID")
+        if nid_hex:
+            hello["node_id"] = bytes.fromhex(nid_hex)
+        if os.environ.get("RAY_TPU_LOCAL_ONLY"):
+            # Raylet-leased worker: the daemon dispatches to us, the GCS
+            # only keeps directory/worker bookkeeping.
+            hello["local_only"] = True
         reply = self.conn.request(
             hello, timeout=RayConfig.worker_register_timeout_s
         )
@@ -218,7 +225,50 @@ class CoreClient:
                 lease["outstanding"] += 1
         return self._push_leased(lease, spec)
 
+    def _raylet_conn(self) -> Optional[PeerConn]:
+        """Connection to this node's raylet lease service, if any."""
+        addr = os.environ.get("RAY_TPU_LOCAL_RAYLET")
+        if not addr:
+            return None
+        with self._lease_lock:
+            conn = getattr(self, "_raylet_peer", None)
+            if conn is not None and not conn.closed:
+                return conn
+        from . import transport
+
+        try:
+            raw = transport.connect(addr, self._authkey)
+        except OSError:
+            return None
+        conn = PeerConn(raw, push_handler=lambda m: None, name="raylet-lease")
+        with self._lease_lock:
+            cur = getattr(self, "_raylet_peer", None)
+            if cur is not None and not cur.closed:
+                # Lost a connect race: keep the winner, drop ours.
+                conn.close()
+                return cur
+            self._raylet_peer = conn
+        return conn
+
     def _acquire_lease(self, key, resources) -> Optional[dict]:
+        # Local dispatch first (reference: tasks submitted on a node
+        # lease from its raylet, not the head — cluster_task_manager):
+        # one node-local hop, the head never sees the dispatch.
+        # Local slots are single-CPU: multi-CPU shapes need the GCS's
+        # quantity accounting (_fits/_acquire), not a 1-slot grant.
+        simple_shape = not resources or (
+            set(resources) == {"CPU"} and resources.get("CPU", 1) <= 1
+        )
+        rconn = self._raylet_conn() if simple_shape else None
+        if rconn is not None:
+            try:
+                reply = rconn.request({"type": "lease_worker"}, timeout=5)
+            except (ConnectionLost, TimeoutError):
+                reply = None
+            if reply and reply.get("ok") and reply.get("addr"):
+                lease = self._connect_lease(key, reply, raylet=True)
+                if lease is not None:
+                    return lease
         try:
             reply = self.conn.request(
                 {"type": "lease_worker", "resources": resources}
@@ -227,24 +277,23 @@ class CoreClient:
             return None
         if not reply.get("ok") or not reply.get("addr"):
             return None
+        return self._connect_lease(key, reply, raylet=False)
+
+    def _connect_lease(self, key, reply, raylet: bool) -> Optional[dict]:
         from . import transport
 
         try:
             raw = transport.connect(reply["addr"], self._authkey)
         except OSError:
             # Worker on another machine (or gone): give the lease back.
-            try:
-                self.conn.send(
-                    {"type": "return_lease", "worker_id": reply["worker_id"]}
-                )
-            except ConnectionLost:
-                pass
+            self._send_lease_return(reply["worker_id"], raylet)
             return None
         lease = {
             "worker_id": reply["worker_id"],
             "key": key,
             "outstanding": 0,
             "returned": False,
+            "raylet": raylet,
         }
         lease["conn"] = PeerConn(
             raw, push_handler=lambda m: None, name="lease",
@@ -252,6 +301,23 @@ class CoreClient:
         with self._lease_lock:
             self._leases.setdefault(key, []).append(lease)
         return lease
+
+    def _send_lease_return(self, worker_id: bytes, raylet: bool) -> None:
+        if raylet:
+            rconn = self._raylet_conn()
+            if rconn is not None:
+                try:
+                    rconn.send(
+                        {"type": "return_lease", "worker_id": worker_id}
+                    )
+                    return
+                except ConnectionLost:
+                    pass
+            return
+        try:
+            self.conn.send({"type": "return_lease", "worker_id": worker_id})
+        except ConnectionLost:
+            pass
 
     def _push_leased(self, lease, spec: TaskSpec) -> List[ObjectRef]:
         """Caller must have already claimed a slot (outstanding += 1).
@@ -311,14 +377,9 @@ class CoreClient:
                 give_back = True
         if give_back:
             # The worker may still be alive with only the lease conn
-            # broken: give the lease back so it isn't stranded W_LEASED
+            # broken: give the lease back so it isn't stranded leased
             # (idempotent if the worker actually died).
-            try:
-                self.conn.send(
-                    {"type": "return_lease", "worker_id": lease["worker_id"]}
-                )
-            except ConnectionLost:
-                pass
+            self._send_lease_return(lease["worker_id"], lease.get("raylet", False))
         if delivered and spec.max_retries <= 0:
             # May have executed: at-most-once for non-retriable tasks
             # (reference: only retriable tasks resubmit on worker crash —
@@ -377,12 +438,11 @@ class CoreClient:
                             to_return.append(lease)
             for lease in to_return:
                 lease["conn"].close()
-                try:
-                    self.conn.send(
-                        {"type": "return_lease", "worker_id": lease["worker_id"]}
-                    )
-                except ConnectionLost:
+                if self.conn.closed:
                     return
+                self._send_lease_return(
+                    lease["worker_id"], lease.get("raylet", False)
+                )
 
     # ----------------------------------------------------- direct actor path
 
@@ -872,6 +932,9 @@ class CoreClient:
 
     def close(self):
         self.conn.close()
+        rp = getattr(self, "_raylet_peer", None)
+        if rp is not None:
+            rp.close()
         self._fetcher.close()
         self.store.close()
 

@@ -143,6 +143,9 @@ class NodeState:
     # Remote drivers register as zero-resource nodes (their store serves
     # pulls) but never receive dispatched work.
     schedulable: bool = True
+    # CPUs the node's daemon has leased to local clients, synced via
+    # heartbeats (the daemon's local dispatch authority).
+    local_cpus_in_use: float = 0.0
 
 
 @dataclass
@@ -216,6 +219,10 @@ class GcsServer:
         self._peers: List[PeerConn] = []
         self._shutdown = False
         self._worker_counter = 0
+        # Per-type control-plane message counts (head-load observability;
+        # the local-dispatch tests assert intra-node chains stay off the
+        # head with these).
+        self.msg_counts: Dict[str, int] = {}
 
         head = NodeState(
             node_id=NodeID.from_random(),
@@ -369,6 +376,7 @@ class GcsServer:
 
     def _dispatch(self, state: Dict[str, Any], msg: Dict[str, Any]):
         mtype = msg["type"]
+        self.msg_counts[mtype] = self.msg_counts.get(mtype, 0) + 1
         delay_spec = RayConfig.testing_rpc_delay_us
         if delay_spec:
             self._maybe_inject_delay(mtype, delay_spec)
@@ -422,19 +430,33 @@ class GcsServer:
             with self._lock:
                 w = self.workers.get(wid)
                 if w is None:
-                    # Externally started worker (tests); adopt onto head node.
+                    # Raylet-local or externally started worker: bind to
+                    # its declared node (object locations must resolve
+                    # to the node whose store/transfer server holds
+                    # them), defaulting to the head.
+                    hello_nid = msg.get("node_id")
+                    node = (
+                        self.nodes.get(hello_nid) if hello_nid else None
+                    ) or self.head_node
                     w = WorkerHandle(
-                        worker_id=WorkerID(wid), node_id=self.head_node.node_id
+                        worker_id=WorkerID(wid), node_id=node.node_id
                     )
                     self.workers[wid] = w
-                    node = self.head_node
                 else:
                     node = self.nodes[w.node_id.binary()]
                 w.conn = peer
                 w.pid = msg.get("pid", 0)
                 w.direct_addr = msg.get("direct_addr", "")
-                w.state = W_IDLE
-                node.pool.add(wid)
+                if msg.get("local_only"):
+                    # Raylet-leased worker: the daemon owns its dispatch
+                    # (reference: raylet local task manager authority,
+                    # cluster_task_manager.cc:44); the GCS only keeps
+                    # the directory/worker bookkeeping — never schedules
+                    # onto it.
+                    w.state = W_LEASED
+                else:
+                    w.state = W_IDLE
+                    node.pool.add(wid)
                 node_id = node.node_id.binary()
                 self._work.notify_all()
         elif role == "driver" and msg.get("transfer_addr"):
@@ -1194,6 +1216,10 @@ class GcsServer:
         with self._lock:
             self._kill_actor(msg["actor_id"], reason="actor exited")
 
+    def _h_msg_counts(self, state, msg):
+        with self._lock:
+            state["peer"].reply(msg, ok=True, counts=dict(self.msg_counts))
+
     def _h_cluster_info(self, state, msg):
         with self._lock:
             total: Dict[str, float] = {}
@@ -1500,6 +1526,20 @@ class GcsServer:
             node = self.nodes.get(msg["node_id"])
             if node is not None:
                 node.last_heartbeat = time.time()
+                # Periodic resource-view sync (reference: ray_syncer.h
+                # resource broadcasting): CPUs the daemon leased out
+                # locally come off this node's schedulable view,
+                # eventually-consistently.
+                local = msg.get("local_cpus_in_use")
+                if local is not None:
+                    delta = local - node.local_cpus_in_use
+                    if delta:
+                        node.local_cpus_in_use = local
+                        node.available["CPU"] = (
+                            node.available.get("CPU", 0.0) - delta
+                        )
+                        if delta < 0:
+                            self._work.notify_all()
 
     # ----------------------------------------------------------- persistence
 

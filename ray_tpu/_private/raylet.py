@@ -116,6 +116,29 @@ class NodeDaemon:
         )
         os.makedirs(self.logs_dir, exist_ok=True)
         self._log_monitor = LogMonitor(self.logs_dir, self._publish_logs)
+        # Local dispatch authority (reference: the raylet owns local
+        # scheduling — cluster_task_manager.cc:44, worker_pool.h:159):
+        # a lease service on a node-local socket grants this daemon's
+        # own worker pool to local clients without a head round-trip;
+        # leased CPUs sync to the GCS resource view via heartbeats.
+        self._local_workers: Dict[bytes, Dict] = {}
+        self._local_leased = 0
+        self._lease_addr = f"/tmp/rtpu-rl-{self.node_ns.rstrip('_')}.sock"
+        try:
+            os.unlink(self._lease_addr)
+        except FileNotFoundError:
+            pass
+        from multiprocessing.connection import Listener as _Listener
+
+        self._lease_listener = _Listener(
+            self._lease_addr, family="AF_UNIX", authkey=authkey
+        )
+        os.environ["RAY_TPU_LOCAL_RAYLET"] = self._lease_addr
+        threading.Thread(
+            target=self._lease_accept_loop, name="raylet-lease", daemon=True
+        ).start()
+        for _ in range(min(2, int(self.resources.get("CPU", 0)))):
+            self._spawn_local_worker()
 
     def _publish_logs(self, entries):
         try:
@@ -156,6 +179,9 @@ class NodeDaemon:
         env["RAY_TPU_WORKER_ID"] = wid.hex()
         env["RAY_TPU_NODE_NS"] = self.node_ns
         env["PYTHONUNBUFFERED"] = "1"  # prints reach the log tailer live
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        if msg.get("local_only"):
+            env["RAY_TPU_LOCAL_ONLY"] = "1"
         if not msg.get("tpu"):
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env["JAX_PLATFORMS"] = "cpu"
@@ -181,6 +207,128 @@ class NodeDaemon:
         if proc is not None:
             proc.terminate()
 
+    # ----------------------------------------------------- local dispatch
+
+    def _spawn_local_worker(self, wid: Optional[WorkerID] = None):
+        """A worker this daemon leases out itself. It registers with the
+        GCS as local_only (directory bookkeeping, never head-scheduled)
+        and reports its direct socket back here via worker_hello.
+        Callers growing the pool reserve the 'starting' record under the
+        lock BEFORE spawning so concurrent denials can't overshoot the
+        CPU cap."""
+        if wid is None:
+            wid = WorkerID(os.urandom(16))
+            with self._lock:
+                self._local_workers[wid.binary()] = {
+                    "state": "starting", "addr": None, "proc": None,
+                }
+        self._spawn_worker(
+            {"worker_id": wid.binary(), "tpu": False, "local_only": True}
+        )
+        with self._lock:
+            rec = self._local_workers.get(wid.binary())
+            if rec is not None:
+                rec["proc"] = self._workers.get(wid.binary())
+
+    def _lease_accept_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                conn = self._lease_listener.accept()
+            except (OSError, EOFError):
+                return
+            except Exception:  # noqa: BLE001 - auth failure
+                continue
+            holder = {"held": set()}
+            peer = PeerConn(
+                conn,
+                push_handler=lambda m, h=holder: self._on_lease_msg(h, m),
+                on_close=lambda h=holder: self._on_lease_peer_close(h),
+                name="raylet-lease",
+                autostart=False,
+            )
+            holder["peer"] = peer
+            peer.start()
+
+    def _on_lease_peer_close(self, holder):
+        # A client died (or closed) with outstanding local leases: free
+        # them or the workers stay leased forever and the heartbeat sync
+        # permanently drains this node's CPU view (mirror of the GCS's
+        # held_leases sweep on peer close).
+        for wid in holder.pop("held", set()):
+            self._return_local_lease(wid)
+
+    def _on_lease_msg(self, holder, msg):
+        peer: PeerConn = holder["peer"]
+        mtype = msg.get("type")
+        if mtype == "worker_hello":
+            with self._lock:
+                rec = self._local_workers.get(msg["worker_id"])
+                if rec is not None:
+                    rec["addr"] = msg["direct_addr"]
+                    rec["state"] = "idle"
+            return
+        if mtype == "lease_worker":
+            granted = None
+            spawn_wid = None
+            with self._lock:
+                for wid, rec in self._local_workers.items():
+                    if rec["state"] == "idle":
+                        rec["state"] = "leased"
+                        self._local_leased += 1
+                        granted = (wid, rec["addr"])
+                        holder["held"].add(wid)
+                        break
+                if granted is None:
+                    live = sum(
+                        1
+                        for r in self._local_workers.values()
+                        if r["state"] != "dead"
+                    )
+                    if live < int(self.resources.get("CPU", 0)):
+                        # Reserve the slot under the lock so concurrent
+                        # denials can't overshoot the CPU cap.
+                        w = WorkerID(os.urandom(16))
+                        self._local_workers[w.binary()] = {
+                            "state": "starting", "addr": None, "proc": None,
+                        }
+                        spawn_wid = w
+            try:
+                if granted is not None:
+                    peer.reply(msg, ok=True, worker_id=granted[0],
+                               addr=granted[1])
+                else:
+                    peer.reply(msg, ok=False)
+            except ConnectionLost:
+                if granted is not None:
+                    holder["held"].discard(granted[0])
+                    self._return_local_lease(granted[0])
+            if spawn_wid is not None:
+                # Grow for the NEXT burst, off the request path — the
+                # denied client falls back to the GCS route now instead
+                # of waiting out a process spawn.
+                threading.Thread(
+                    target=self._spawn_local_worker, args=(spawn_wid,),
+                    daemon=True,
+                ).start()
+            return
+        if mtype == "return_lease":
+            holder["held"].discard(msg["worker_id"])
+            self._return_local_lease(msg["worker_id"])
+
+    def _return_local_lease(self, wid: bytes):
+        with self._lock:
+            rec = self._local_workers.get(wid)
+            if rec is not None and rec["state"] == "leased":
+                rec["state"] = "idle"
+                self._local_leased -= 1
+            proc = rec.get("proc") if rec else None
+        if proc is not None and proc.poll() is not None:
+            with self._lock:
+                if rec["state"] != "dead":
+                    if rec["state"] == "leased":
+                        self._local_leased -= 1
+                    rec["state"] = "dead"
+
     # ------------------------------------------------------------ lifecycle
 
     def _heartbeat_loop(self):
@@ -188,7 +336,11 @@ class NodeDaemon:
         while not self._shutdown.wait(interval):
             try:
                 self.conn.send(
-                    {"type": "node_heartbeat", "node_id": self.node_id}
+                    {
+                        "type": "node_heartbeat",
+                        "node_id": self.node_id,
+                        "local_cpus_in_use": float(self._local_leased),
+                    }
                 )
             except ConnectionLost:
                 # Head may be restarting. The conn's own on_close drives

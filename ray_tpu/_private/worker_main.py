@@ -802,6 +802,23 @@ def main():
         address, authkey, role="worker", worker_id=worker_id,
         push_handler=push, direct_addr=direct_addr,
     )
+    raylet_addr = os.environ.get("RAY_TPU_LOCAL_RAYLET")
+    if raylet_addr and os.environ.get("RAY_TPU_LOCAL_ONLY"):
+        # Report our direct socket to the owning raylet so it can lease
+        # this worker to local clients (local dispatch authority).
+        from multiprocessing.connection import Client as _MpClient
+
+        try:
+            rl = _MpClient(raylet_addr, family="AF_UNIX", authkey=authkey)
+            rl.send(
+                {
+                    "type": "worker_hello",
+                    "worker_id": worker_id.binary(),
+                    "direct_addr": direct_addr,
+                }
+            )
+        except OSError:
+            pass
     rt = WorkerRuntime(client, task_queue)
     rt_holder["rt"] = rt
 
