@@ -29,6 +29,13 @@ _DEFAULTS: Dict[str, Any] = {
     "health_check_failure_threshold": 5,
     # Task scheduling.
     "max_pending_lease_requests_per_scheduling_class": 10,
+    # Hybrid policy (reference: hybrid_scheduling_policy.h:29-49 +
+    # ray_config_def.h scheduler_spread_threshold/top_k_fraction): pack
+    # nodes while critical-resource utilization stays under the
+    # threshold, then least-utilized-first; randomize among the best
+    # ceil(top_k_fraction * num_nodes) to avoid thundering herds.
+    "scheduler_spread_threshold": 0.5,
+    "scheduler_top_k_fraction": 0.2,
     # Testing hook: inject a delay (us range "min:max") into control-plane
     # message handling, keyed by message type (reference:
     # RAY_testing_asio_delay_us, ray_config_def.h:832).

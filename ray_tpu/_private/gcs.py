@@ -24,6 +24,7 @@ Tables owned here:
 """
 from __future__ import annotations
 
+import math
 import os
 import random
 import subprocess
@@ -282,6 +283,8 @@ class GcsServer:
         self._health_thread = threading.Thread(
             target=self._health_loop, name="gcs-health", daemon=True
         )
+        # Top-k tie-break for the hybrid scheduling policy.
+        self._sched_rng = random.Random(0xC0FFEE)
         # Memory-pressure ladder: background spilling of cold sealed
         # objects at high pool utilization (reference:
         # local_object_manager.h:41-110) + a host-memory monitor that
@@ -2087,13 +2090,16 @@ class GcsServer:
             _release(node.available, res)
 
     def _pick_node(self, spec: TaskSpec) -> Optional[NodeState]:
-        """Hybrid-policy stand-in: prefer nodes with available resources,
-        break ties toward emptier nodes (reference:
-        raylet/scheduling/policy/hybrid_scheduling_policy.h:29-49).
+        """Node selection with the reference's policy surface
+        (raylet/scheduling/policy/): NodeAffinity (hard/soft),
+        task-level SPREAD, and the hybrid default — binpack nodes while
+        critical-resource utilization stays under the spread threshold,
+        then least-utilized-first, randomized among the top-k
+        (hybrid_scheduling_policy.h:29-49).
 
         Raises _Unschedulable for permanently-unplaceable tasks (bad or
-        removed placement group) so the caller fails them instead of
-        requeueing forever."""
+        removed placement group, dead hard-affinity target) so the
+        caller fails them instead of requeueing forever."""
         res = self._task_resources(spec)
         if spec.placement_group_id is not None:
             pg = self.placement_groups.get(spec.placement_group_id.binary())
@@ -2116,6 +2122,37 @@ class GcsServer:
                     _acquire(bundle.available, res)
                     return self.nodes.get(bundle.node_id.binary())
             return None
+        strat = spec.scheduling_strategy
+        if strat is not None and hasattr(strat, "node_id"):
+            # NodeAffinity: hard pins (wait while the target is merely
+            # busy, fail if it is gone); soft falls through to the
+            # default policy when the target can't take the task
+            # (reference: scheduling_policy.h NodeAffinitySchedulingPolicy).
+            target = bytes(strat.node_id)
+            node = self.nodes.get(target)
+            if (
+                node is not None
+                and node.alive
+                and node.schedulable
+                and _fits(node.available, res)
+            ):
+                _acquire(node.available, res)
+                return node
+            if not getattr(strat, "soft", False):
+                if node is None or not node.alive:
+                    raise _Unschedulable(
+                        f"node affinity target {target.hex()[:12]} is not "
+                        "in the cluster"
+                    )
+                if not node.schedulable or not _fits(node.total, res):
+                    # The target can NEVER take this task (draining, or
+                    # the shape exceeds the node's total) — fail now
+                    # instead of requeueing forever.
+                    raise _Unschedulable(
+                        f"node affinity target {target.hex()[:12]} cannot "
+                        f"ever satisfy {res}"
+                    )
+                return None
         candidates = [
             n
             for n in self.nodes.values()
@@ -2123,12 +2160,55 @@ class GcsServer:
         ]
         if not candidates:
             return None
-        node = max(
-            candidates,
-            key=lambda n: sum(n.available.get(k, 0.0) for k in ("CPU", "TPU")),
-        )
+        if strat == "SPREAD":
+            # Task-level SPREAD: least-utilized feasible node
+            # (reference: scheduling_policy.h SpreadSchedulingPolicy).
+            node = min(
+                candidates,
+                key=lambda n: (self._node_util(n, res), n.node_id.binary()),
+            )
+        else:
+            node = self._hybrid_pick(candidates, res)
         _acquire(node.available, res)
         return node
+
+    def _node_util(self, n: NodeState, res: Dict[str, float]) -> float:
+        """Critical-resource utilization of the node if res lands on it."""
+        worst = 0.0
+        for k, total in n.total.items():
+            if total <= 0:
+                continue
+            used = total - n.available.get(k, 0.0) + res.get(k, 0.0)
+            worst = max(worst, used / total)
+        return worst
+
+    def _hybrid_pick(
+        self, candidates: List[NodeState], res: Dict[str, float]
+    ) -> NodeState:
+        """The reference hybrid policy: nodes whose post-placement
+        utilization stays under the spread threshold all score 0 and
+        sort in stable node-id order — successive tasks pack onto the
+        same nodes (keeping TPU pods' ICI-adjacent capacity free for
+        gangs) — while saturated nodes sort least-utilized-first.
+        Randomizing among the top ceil(k_fraction * n) spreads
+        herd-arrival bursts (hybrid_scheduling_policy.h:29-49)."""
+        threshold = RayConfig.scheduler_spread_threshold
+        scored = sorted(
+            (
+                (
+                    (0.0 if u <= threshold else u),
+                    n.node_id.binary(),
+                    n,
+                )
+                for n in candidates
+                if (u := self._node_util(n, res)) is not None
+            ),
+            key=lambda t: (t[0], t[1]),
+        )
+        k = max(
+            1, math.ceil(len(scored) * RayConfig.scheduler_top_k_fraction)
+        )
+        return scored[self._sched_rng.randrange(k)][2]
 
     def _sched_loop(self):
         while True:
@@ -2160,9 +2240,17 @@ class GcsServer:
             try:
                 node = self._pick_node(spec)
             except _Unschedulable as e:
-                from ..exceptions import PlacementGroupSchedulingError
+                from ..exceptions import (
+                    PlacementGroupSchedulingError,
+                    TaskUnschedulableError,
+                )
 
-                self._fail_task_returns(spec, PlacementGroupSchedulingError(str(e)))
+                exc_cls = (
+                    PlacementGroupSchedulingError
+                    if spec.placement_group_id is not None
+                    else TaskUnschedulableError
+                )
+                self._fail_task_returns(spec, exc_cls(str(e)))
                 progressed = True
                 continue
             if node is None:

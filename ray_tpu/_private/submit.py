@@ -53,6 +53,29 @@ def resolve_options(
     return opts
 
 
+def normalize_strategy(strategy: Any) -> Any:
+    """Ship-ready scheduling strategy: PG strategies are folded into the
+    spec's placement fields by the caller (→ None here); "SPREAD" passes
+    through; NodeAffinity gets its node_id coerced to raw bytes so the
+    scheduler compares against NodeState keys directly."""
+    if strategy is None or hasattr(strategy, "placement_group"):
+        return None
+    if isinstance(strategy, str):
+        return None if strategy == "DEFAULT" else strategy
+    node_id = getattr(strategy, "node_id", None)
+    if node_id is not None and not isinstance(node_id, bytes):
+        # Coerce on a copy — the caller may reuse (or share) the
+        # strategy object across submissions.
+        import copy
+
+        strategy = copy.copy(strategy)
+        if hasattr(node_id, "binary"):
+            strategy.node_id = node_id.binary()
+        elif isinstance(node_id, str):
+            strategy.node_id = bytes.fromhex(node_id)
+    return strategy
+
+
 def resources_from_options(opts: Dict[str, Any], is_actor: bool = False) -> Dict[str, float]:
     """Tasks default to 1 CPU; actors default to 0 for their lifetime
     (reference: ray_option_utils.py — num_cpus default 1 for tasks,

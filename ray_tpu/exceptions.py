@@ -121,3 +121,9 @@ class RuntimeEnvSetupError(RayTpuError):
 
 class PlacementGroupSchedulingError(RayTpuError):
     pass
+
+
+class TaskUnschedulableError(RayTpuError):
+    """The task can never be scheduled (e.g. a hard NodeAffinity target
+    that left the cluster). Reference: exceptions.py
+    TaskUnschedulableError."""

@@ -162,6 +162,7 @@ class RemoteFunction:
             placement_group_bundle_index=(
                 bundle_index if bundle_index is not None else -1
             ),
+            scheduling_strategy=_submit.normalize_strategy(strategy),
             runtime_env=_submit.prepare_runtime_env(
                 _maybe_trace(opts.get("runtime_env"),
                              opts.get("name") or self._fn.__name__),

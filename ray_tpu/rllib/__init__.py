@@ -21,7 +21,17 @@ instead of DDP/NCCL.
 """
 from __future__ import annotations
 
+from .core.multi_rl_module import MultiRLModule, MultiRLModuleSpec  # noqa: F401
 from .core.rl_module import RLModule, RLModuleSpec  # noqa: F401
 from .env.episode import SingleAgentEpisode  # noqa: F401
+from .env.multi_agent_env import MultiAgentEnv, make_multi_agent  # noqa: F401
 
-__all__ = ["RLModule", "RLModuleSpec", "SingleAgentEpisode"]
+__all__ = [
+    "MultiAgentEnv",
+    "MultiRLModule",
+    "MultiRLModuleSpec",
+    "RLModule",
+    "RLModuleSpec",
+    "SingleAgentEpisode",
+    "make_multi_agent",
+]

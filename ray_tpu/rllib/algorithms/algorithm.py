@@ -40,6 +40,7 @@ class Algorithm(Trainable):
         self._iteration = 0
         self._total_env_steps = 0
         self._episode_returns: deque = deque(maxlen=100)
+        self._module_returns: Dict[str, deque] = {}
         self._start = time.monotonic()
         # Trainable.__init__ assigns self.config = the dict and calls
         # setup(); setup() re-binds self.config to the AlgorithmConfig.
@@ -60,12 +61,21 @@ class Algorithm(Trainable):
             if callable(self.config.env)
             else gym.make(self.config.env, **(self.config.env_config or {}))
         )
-        obs_space = probe.observation_space
-        act_space = probe.action_space
+        if self.config.is_multi_agent:
+            from ..core.multi_agent_learner_group import (
+                MultiAgentLearnerGroup,
+            )
+
+            self._module_spec = self.config.multi_module_spec(probe)
+            group_cls = MultiAgentLearnerGroup
+        else:
+            self._module_spec = self.config.module_spec(
+                probe.observation_space, probe.action_space
+            )
+            group_cls = LearnerGroup
         probe.close()
-        self._module_spec = self.config.module_spec(obs_space, act_space)
         self.env_runner_group = EnvRunnerGroup(self.env_runner_config())
-        self.learner_group = LearnerGroup(
+        self.learner_group = group_cls(
             learner_cls=self.learner_class,
             module_spec=self._module_spec,
             config=self.learner_config(),
@@ -86,9 +96,12 @@ class Algorithm(Trainable):
 
     def step(self) -> Dict[str, Any]:
         learner_metrics = self.training_step()
-        self._episode_returns.extend(
-            self.env_runner_group.get_metrics()["episode_returns"]
-        )
+        runner_metrics = self.env_runner_group.get_metrics()
+        self._episode_returns.extend(runner_metrics["episode_returns"])
+        for mid, rets in runner_metrics.get("module_returns", {}).items():
+            self._module_returns.setdefault(
+                mid, deque(maxlen=100)
+            ).extend(rets)
         self._iteration += 1
         result = {
             "training_iteration": self._iteration,
@@ -108,6 +121,12 @@ class Algorithm(Trainable):
             },
             "learners": learner_metrics,
         }
+        if self._module_returns:
+            result["env_runners"]["module_episode_return_mean"] = {
+                mid: float(np.mean(rets))
+                for mid, rets in self._module_returns.items()
+                if rets
+            }
         # Flat aliases used by Tune stoppers/schedulers.
         result["episode_return_mean"] = result["env_runners"][
             "episode_return_mean"

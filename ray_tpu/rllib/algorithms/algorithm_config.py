@@ -40,6 +40,10 @@ class AlgorithmConfig:
         # module
         self.module_class: Optional[type] = None
         self.model_config: Dict[str, Any] = {}
+        # multi-agent (reference: AlgorithmConfig.multi_agent —
+        # policies + policy_mapping_fn; None means single-agent)
+        self.policies: Optional[Dict[str, Any]] = None
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
         # misc
         self.seed: Optional[int] = None
 
@@ -101,6 +105,39 @@ class AlgorithmConfig:
             self.model_config = dict(model_config)
         return self
 
+    def multi_agent(
+        self, *, policies=None, policy_mapping_fn=None
+    ) -> "AlgorithmConfig":
+        """Declare the policy modules and the agent→module mapping.
+
+        ``policies``: dict {module_id: None | RLModuleSpec |
+        (module_class, model_config)}. None uses the algorithm's
+        default module. ``policy_mapping_fn(agent_id) -> module_id``
+        must be picklable (module-level function / functools.partial)
+        to ship to remote env runners.
+        """
+        if policies is not None:
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return bool(self.policies)
+
+    def resolved_policy_mapping_fn(self):
+        """The configured mapping, or a picklable default: all agents →
+        the single module if there is exactly one, else agent_id ==
+        module_id."""
+        from ..env.multi_agent_env import ConstantMapping, agent_id_mapping
+
+        if self.policy_mapping_fn is not None:
+            return self.policy_mapping_fn
+        if self.policies and len(self.policies) == 1:
+            return ConstantMapping(next(iter(self.policies)))
+        return agent_id_mapping
+
     def debugging(self, *, seed=None) -> "AlgorithmConfig":
         if seed is not None:
             self.seed = seed
@@ -118,8 +155,45 @@ class AlgorithmConfig:
             model_config=dict(self.model_config),
         )
 
+    def multi_module_spec(self, env) -> "Any":
+        """MultiRLModuleSpec with spaces probed from the multi-agent env
+        (one representative agent per module)."""
+        from ..core.multi_rl_module import MultiRLModuleSpec
+        from ..core.rl_module import RLModuleSpec as _Spec
+
+        mapping = self.resolved_policy_mapping_fn()
+        specs: Dict[str, _Spec] = {}
+        for mid, policy in (self.policies or {}).items():
+            rep = next(
+                (a for a in env.possible_agents if mapping(a) == mid), None
+            )
+            if rep is None:
+                raise ValueError(f"no agent maps to module {mid!r}")
+            if isinstance(policy, _Spec):
+                spec = policy
+                if spec.observation_space is None:
+                    spec.observation_space = env.observation_space(rep)
+                if spec.action_space is None:
+                    spec.action_space = env.action_space(rep)
+            else:
+                cls, mcfg = (
+                    policy
+                    if isinstance(policy, tuple)
+                    else (None, None)
+                )
+                spec = _Spec(
+                    module_class=cls
+                    or self.module_class
+                    or self.default_module_class,
+                    observation_space=env.observation_space(rep),
+                    action_space=env.action_space(rep),
+                    model_config=dict(mcfg or self.model_config),
+                )
+            specs[mid] = spec
+        return MultiRLModuleSpec(specs)
+
     def env_runner_config(self, module_spec) -> Dict[str, Any]:
-        return {
+        cfg = {
             "env": self.env,
             "env_config": self.env_config,
             "num_env_runners": self.num_env_runners,
@@ -129,6 +203,12 @@ class AlgorithmConfig:
             "module_spec": module_spec,
             "seed": self.seed,
         }
+        if self.is_multi_agent:
+            from ..env.multi_agent_env_runner import MultiAgentEnvRunner
+
+            cfg["runner_cls"] = MultiAgentEnvRunner
+            cfg["policy_mapping_fn"] = self.resolved_policy_mapping_fn()
+        return cfg
 
     def learner_config(self) -> Dict[str, Any]:
         return {

@@ -1,0 +1,189 @@
+"""APPO: asynchronous PPO (IMPALA pipeline + clipped surrogate).
+
+Reference: rllib/algorithms/appo/ — the IMPALA architecture (continuous
+async sampling, V-trace off-policy correction) with PPO's clipped
+surrogate objective replacing the plain policy gradient, plus an
+optional KL penalty against a slow-moving target policy
+(appo.py:88-104, appo_torch_learner.py). Learner update is one jitted
+program; the target-policy refresh is a periodic host-side copy like
+DQN's target sync.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from .impala import IMPALA, IMPALAConfig, IMPALALearner
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.4
+        self.use_kl_loss = False
+        self.kl_coeff = 0.2
+        self.kl_target = 0.01
+        # In learner updates (reference counts target updates in env
+        # steps; one update == one train batch here).
+        self.target_network_update_freq = 2
+
+    @property
+    def algo_class(self):
+        return APPO
+
+    def learner_config(self):
+        cfg = super().learner_config()
+        cfg.update(
+            clip_param=self.clip_param,
+            use_kl_loss=self.use_kl_loss,
+            kl_coeff=self.kl_coeff,
+            kl_target=self.kl_target,
+            target_network_update_freq=self.target_network_update_freq,
+        )
+        return cfg
+
+
+class APPOLearner(IMPALALearner):
+    """V-trace targets exactly as IMPALA; the policy term swaps the
+    plain PG for PPO's clipped surrogate, with the ratio taken against
+    the behavior policy's logp recorded at sample time."""
+
+    def build(self):
+        super().build()
+        import jax
+
+        self.target_params = jax.device_get(self.params)
+        self._updates = 0
+        # Adaptive KL coefficient lives outside the jitted loss (it
+        # changes between updates, not within one).
+        self._kl_coeff = float(self.config.get("kl_coeff", 0.2))
+
+    def compute_loss(self, params, batch, rng) -> Tuple[Any, Dict[str, Any]]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        from ..core.rl_module import Columns
+
+        B, T = batch["actions"].shape
+        obs_flat = batch["obs"].reshape((B * T,) + batch["obs"].shape[2:])
+        out = self.module.forward_train(params, {Columns.OBS: obs_flat})
+        logits = out[Columns.ACTION_DIST_INPUTS].reshape(B, T, -1)
+        values = out[Columns.VF_PREDS].reshape(B, T)
+        bootstrap = self.module.compute_values(params, batch["bootstrap_obs"])
+
+        z = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+        actions = batch["actions"].astype(jnp.int32)
+        target_logp = jnp.take_along_axis(z, actions[..., None], axis=-1)[..., 0]
+
+        mask = batch["mask"]
+        rho = jax.lax.stop_gradient(
+            jnp.exp(target_logp - batch["action_logp"])
+        )
+        rho_clip = jnp.minimum(rho, cfg["vtrace_clip_rho_threshold"])
+        c_clip = jnp.minimum(rho, cfg["vtrace_clip_c_threshold"])
+        bootstrap = jax.lax.stop_gradient(bootstrap)
+        discounts = cfg["gamma"] * (1.0 - batch["terminateds"]) * mask
+        values_stop = jax.lax.stop_gradient(values)
+        next_valid = jnp.concatenate(
+            [mask[:, 1:], jnp.zeros_like(mask[:, :1])], axis=1
+        )
+        v_shift = jnp.concatenate(
+            [values_stop[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1
+        )
+        v_tp1 = next_valid * v_shift + (1.0 - next_valid) * bootstrap[:, None]
+        deltas = mask * rho_clip * (
+            batch["rewards"] + discounts * v_tp1 - values_stop
+        )
+
+        def scan_fn(acc, xs):
+            delta_t, disc_t, c_t = xs
+            acc = delta_t + disc_t * c_t * acc
+            return acc, acc
+
+        _, acc = jax.lax.scan(
+            scan_fn,
+            jnp.zeros((B,), values.dtype),
+            (deltas.T, discounts.T, c_clip.T),
+            reverse=True,
+        )
+        vs = values_stop + acc.T
+        vs_shift = jnp.concatenate(
+            [vs[:, 1:], jnp.zeros_like(bootstrap)[:, None]], axis=1
+        )
+        vs_tp1 = next_valid * vs_shift + (1.0 - next_valid) * bootstrap[:, None]
+        pg_adv = jax.lax.stop_gradient(
+            rho_clip * (batch["rewards"] + discounts * vs_tp1 - values_stop)
+        )
+
+        denom = jnp.maximum(mask.sum(), 1.0)
+        if cfg.get("standardize_advantages", True):
+            adv_mean = jnp.sum(pg_adv * mask) / denom
+            adv_var = jnp.sum(jnp.square(pg_adv - adv_mean) * mask) / denom
+            pg_adv = (pg_adv - adv_mean) / jnp.maximum(jnp.sqrt(adv_var), 1e-4)
+
+        # ---- PPO clip on the importance ratio (the APPO difference).
+        ratio = jnp.exp(target_logp - batch["action_logp"])
+        clipped = jnp.clip(
+            ratio, 1.0 - cfg["clip_param"], 1.0 + cfg["clip_param"]
+        )
+        surrogate = jnp.minimum(ratio * pg_adv, clipped * pg_adv)
+        policy_loss = -jnp.sum(surrogate * mask) / denom
+
+        vf_loss = 0.5 * jnp.sum(jnp.square(vs - values) * mask) / denom
+        entropy = -jnp.sum(jnp.exp(z) * z * mask[..., None]) / denom
+        total = (
+            policy_loss
+            + cfg["vf_loss_coeff"] * vf_loss
+            - cfg["entropy_coeff"] * entropy
+        )
+        metrics = {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.sum(rho * mask) / denom,
+        }
+        if cfg.get("use_kl_loss"):
+            # KL(target || online) against the slow policy, averaged
+            # over valid steps (reference: appo_torch_learner KL term).
+            t_logits = self.module.forward_train(
+                batch["appo_target_params"], {Columns.OBS: obs_flat}
+            )[Columns.ACTION_DIST_INPUTS].reshape(B, T, -1)
+            tz = t_logits - jax.scipy.special.logsumexp(
+                t_logits, axis=-1, keepdims=True
+            )
+            kl = jnp.sum(jnp.exp(tz) * (tz - z), axis=-1)
+            mean_kl = jnp.sum(kl * mask) / denom
+            total = total + batch["appo_kl_coeff"] * mean_kl
+            metrics["mean_kl"] = mean_kl
+        return total, metrics
+
+    def update(self, batch):
+        import jax
+        import jax.numpy as jnp
+
+        if self.config.get("use_kl_loss"):
+            batch = dict(
+                batch,
+                appo_target_params=self.target_params,
+                appo_kl_coeff=jnp.asarray(self._kl_coeff, jnp.float32),
+            )
+        metrics = super().update(batch)
+        self._updates += 1
+        if self.config.get("use_kl_loss") and "mean_kl" in metrics:
+            # Reference's adaptive KL: 1.5x band around the target.
+            if metrics["mean_kl"] > 2.0 * self.config["kl_target"]:
+                self._kl_coeff *= 1.5
+            elif metrics["mean_kl"] < 0.5 * self.config["kl_target"]:
+                self._kl_coeff *= 0.5
+            metrics["kl_coeff"] = self._kl_coeff
+        if self._updates % max(
+            1, int(self.config.get("target_network_update_freq", 2))
+        ) == 0:
+            self.target_params = jax.device_get(self.params)
+        return metrics
+
+
+class APPO(IMPALA):
+    learner_class = APPOLearner

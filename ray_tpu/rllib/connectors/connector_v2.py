@@ -59,7 +59,7 @@ class SampleCategoricalActions(ConnectorV2):
         logits = np.asarray(batch["action_dist_inputs"], np.float32)
         z = logits - logits.max(axis=-1, keepdims=True)
         logp_all = z - np.log(np.exp(z).sum(axis=-1, keepdims=True))
-        if self.explore:
+        if kwargs.get("explore", self.explore):
             # Gumbel-max sampling, vectorized over envs.
             g = self.rng.gumbel(size=logits.shape)
             actions = np.argmax(logits + g, axis=-1)
@@ -82,7 +82,11 @@ class EpsilonGreedyActions(ConnectorV2):
     def __call__(self, *, rl_module=None, batch=None, episodes=None, **kwargs):
         q = np.asarray(batch["q_values"] if "q_values" in batch
                        else batch["action_dist_inputs"])
-        eps = self.epsilon_fn(self.step)
+        eps = (
+            self.epsilon_fn(self.step)
+            if kwargs.get("explore", True)
+            else 0.0
+        )
         self.step += q.shape[0]
         greedy = np.argmax(q, axis=-1)
         random = self.rng.integers(0, q.shape[-1], size=q.shape[0])

@@ -21,12 +21,13 @@ class EnvRunnerGroup:
         self._config = config
         self._blob = pickle.dumps(config)
         n = config.get("num_env_runners", 0)
+        runner_cls = config.get("runner_cls") or SingleAgentEnvRunner
         self._local: Optional[SingleAgentEnvRunner] = None
         self._manager: Optional[FaultTolerantActorManager] = None
         if n == 0:
-            self._local = SingleAgentEnvRunner(self._blob, worker_index=0)
+            self._local = runner_cls(self._blob, worker_index=0)
         else:
-            actor_cls = ray_tpu.remote(SingleAgentEnvRunner).options(
+            actor_cls = ray_tpu.remote(runner_cls).options(
                 num_cpus=config.get("num_cpus_per_env_runner", 1)
             )
             self._manager = FaultTolerantActorManager(
@@ -45,10 +46,14 @@ class EnvRunnerGroup:
     def num_restarts(self) -> int:
         return self._manager.num_restarts if self._manager else 0
 
-    def sample(self, *, num_timesteps=None, num_episodes=None) -> List:
+    def sample(
+        self, *, num_timesteps=None, num_episodes=None, explore=True
+    ) -> List:
         if self._local is not None:
             return self._local.sample(
-                num_timesteps=num_timesteps, num_episodes=num_episodes
+                num_timesteps=num_timesteps,
+                num_episodes=num_episodes,
+                explore=explore,
             )
         per = None
         per_eps = None
@@ -57,7 +62,7 @@ class EnvRunnerGroup:
         if num_episodes is not None:
             per_eps = max(1, num_episodes // self._manager.num_actors)
         results = self._manager.foreach_actor(
-            "sample", num_timesteps=per, num_episodes=per_eps
+            "sample", num_timesteps=per, num_episodes=per_eps, explore=explore
         )
         episodes = []
         for _, eps in results:
@@ -83,9 +88,15 @@ class EnvRunnerGroup:
         if self._local is not None:
             return self._local.get_metrics()
         returns: List[float] = []
+        module_returns: Dict[str, List[float]] = {}
         for _, m in self._manager.foreach_actor("get_metrics"):
             returns.extend(m["episode_returns"])
-        return {"episode_returns": returns}
+            for mid, rs in m.get("module_returns", {}).items():
+                module_returns.setdefault(mid, []).extend(rs)
+        out: Dict[str, Any] = {"episode_returns": returns}
+        if module_returns:
+            out["module_returns"] = module_returns
+        return out
 
     def stop(self):
         if self._manager:

@@ -129,7 +129,9 @@ class SingleAgentEnvRunner:
         while True:
             batch = self.env_to_module(episodes=self._episodes)
             outs = self._forward(batch)
-            outs = self.module_to_env(batch=outs, episodes=self._episodes)
+            outs = self.module_to_env(
+                batch=outs, episodes=self._episodes, explore=explore
+            )
             actions = np.asarray(outs["actions"])
             obs, rewards, terms, truncs, _ = self.env.step(actions)
             extra_keys = [k for k in ("action_logp",) if k in outs]
