@@ -25,6 +25,7 @@ import inspect as _inspect
 from ._private.worker import (  # noqa: F401
     available_resources,
     cluster_resources,
+    drain_node,
     free,
     get,
     get_actor,

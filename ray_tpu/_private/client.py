@@ -14,7 +14,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import serialization
 from .config import RayConfig
-from .ids import ObjectID, WorkerID
+from .ids import ObjectID, WorkerID, fast_unique_bytes
 from .object_store import ObjectStore
 from .protocol import OP_CALL, ConnectionLost, PeerConn
 from .task_spec import TaskSpec
@@ -462,9 +462,7 @@ class CoreClient:
         conn = self._direct_conns.get(aid)
         if conn is None or conn == "resolving" or isinstance(conn, str):
             return None
-        import os as _os
-
-        tid = _os.urandom(16)
+        tid = fast_unique_bytes()
         return self._send_frame(
             conn, aid, tid, method_name, args_blob, num_returns, deps
         )
@@ -622,7 +620,7 @@ class CoreClient:
     # ------------------------------------------------------------------ objects
 
     def put(self, value: Any) -> ObjectRef:
-        oid = ObjectID.from_random()
+        oid = ObjectID(fast_unique_bytes())
         self.put_with_id(oid, value)
         return ObjectRef(oid, self.worker_id.binary())
 

@@ -144,6 +144,12 @@ class NodeState:
     # Remote drivers register as zero-resource nodes (their store serves
     # pulls) but never receive dispatched work.
     schedulable: bool = True
+    # Graceful drain (reference: node_manager.h:551 HandleDrainRaylet):
+    # a draining node takes no new work; it is removed once its running
+    # tasks finish or the deadline passes.
+    draining: bool = False
+    drain_deadline: float = 0.0
+    drain_reason: str = ""
     # CPUs the node's daemon has leased to local clients, synced via
     # heartbeats (the daemon's local dispatch authority).
     local_cpus_in_use: float = 0.0
@@ -285,6 +291,8 @@ class GcsServer:
         )
         # Top-k tie-break for the hybrid scheduling policy.
         self._sched_rng = random.Random(0xC0FFEE)
+        # In-flight worker stack-dump requests: token -> (peer, msg, ts).
+        self._stack_waiters: Dict[str, Tuple] = {}
         # Memory-pressure ladder: background spilling of cold sealed
         # objects at high pool utilization (reference:
         # local_object_manager.h:41-110) + a host-memory monitor that
@@ -1766,6 +1774,53 @@ class GcsServer:
             self._log_subscribers.append(state["peer"])
         state["peer"].reply(msg, ok=True)
 
+    def _h_worker_stacks(self, state, msg):
+        """Live thread-stack capture from a worker (reference: the
+        dashboard's py-spy profiling, reporter/profile_manager.py —
+        here via sys._current_frames inside the worker, no ptrace)."""
+        wid = msg["worker_id"]
+        with self._lock:
+            w = self.workers.get(wid)
+            conn = w.conn if w is not None else None
+            if conn is None:
+                state["peer"].reply(
+                    msg, ok=False, error="no such worker (or not connected)"
+                )
+                return
+            token = f"{wid.hex()[:8]}-{time.time():.6f}"
+            self._stack_waiters[token] = (state["peer"], msg, time.time())
+        try:
+            conn.send({"type": "dump_stacks", "token": token})
+        except ConnectionLost:
+            with self._lock:
+                self._stack_waiters.pop(token, None)
+            state["peer"].reply(msg, ok=False, error="worker connection lost")
+
+    def _h_stack_dump(self, state, msg):
+        with self._lock:
+            waiter = self._stack_waiters.pop(msg.get("token"), None)
+        if waiter is None:
+            return
+        peer, orig, _ = waiter
+        try:
+            peer.reply(orig, ok=True, text=msg.get("text", ""))
+        except ConnectionLost:
+            pass
+
+    def _sweep_stack_waiters(self, now: float) -> None:
+        with self._lock:
+            expired = [
+                t
+                for t, (_, _, ts) in self._stack_waiters.items()
+                if now - ts > 10.0
+            ]
+            waiters = [self._stack_waiters.pop(t) for t in expired]
+        for peer, orig, _ in waiters:
+            try:
+                peer.reply(orig, ok=False, error="stack dump timed out")
+            except ConnectionLost:
+                pass
+
     def _h_get_logs(self, state, msg):
         prefix = msg.get("worker_prefix") or ""
         n = msg.get("tail", 1000)
@@ -1952,6 +2007,8 @@ class GcsServer:
             time.sleep(period)
             self._flush_log_repeats()
             now = time.time()
+            self._drain_tick(now)
+            self._sweep_stack_waiters(now)
             with self._lock:
                 stale = [
                     n.node_id.binary()
@@ -2006,6 +2063,67 @@ class GcsServer:
             self.nodes[node.node_id.binary()] = node
             self._work.notify_all()
         state["peer"].reply(msg, ok=True, node_id=node.node_id.binary())
+
+    def _h_drain_node(self, state, msg):
+        """Graceful drain (reference: node_manager.h:551): stop new
+        placements immediately; the health loop finalizes removal once
+        the node is quiet (or the deadline passes)."""
+        with self._lock:
+            node = self.nodes.get(msg["node_id"])
+            if node is None or not node.alive:
+                state["peer"].reply(msg, ok=False, error="no such node")
+                return
+            node.schedulable = False
+            node.draining = True
+            node.drain_reason = msg.get("reason", "") or "drain requested"
+            node.drain_deadline = time.time() + float(
+                msg.get("deadline_s", 30.0)
+            )
+            conn = node.conn
+        if conn is not None:
+            # Tell the daemon so its local-lease authority stops
+            # granting workers too.
+            try:
+                conn.send({"type": "drain"})
+            except ConnectionLost:
+                pass
+        state["peer"].reply(msg, ok=True, accepted=True)
+
+    def _drain_tick(self, now: float):
+        """Finalize drains whose nodes went quiet or whose deadline
+        passed (called from the health loop)."""
+        with self._lock:
+            to_finalize = []
+            for node in self.nodes.values():
+                if not (node.alive and node.draining):
+                    continue
+                # Busy = dispatched work the GCS can see (W_BUSY or a
+                # non-empty inflight map) OR a leased worker, whose
+                # tasks ride the direct transport and are invisible
+                # here — leases return on client idle timeout, so this
+                # converges (or the deadline forces the issue).
+                busy = any(
+                    w.node_id == node.node_id
+                    and (
+                        w.state == W_BUSY
+                        or w.state == W_LEASED
+                        or w.inflight
+                    )
+                    for w in self.workers.values()
+                    if w.state != W_DEAD
+                )
+                if not busy or now >= node.drain_deadline:
+                    to_finalize.append(node)
+        for node in to_finalize:
+            conn = node.conn
+            self._handle_node_death(
+                node.node_id.binary(), f"drained: {node.drain_reason}"
+            )
+            if conn is not None:
+                try:
+                    conn.send({"type": "shutdown"})
+                except ConnectionLost:
+                    pass
 
     def _h_remove_node(self, state, msg):
         with self._lock:

@@ -294,6 +294,8 @@ def main(argv=None) -> int:
         groups[name]()
     RESULTS["_wall_seconds"] = round(time.time() - t0, 1)
     if args.out:
+        import os as _os
+
         out = {
             "results": RESULTS,
             "vs_baseline": {
@@ -302,6 +304,11 @@ def main(argv=None) -> int:
                 if k in RESULTS
             },
             "baseline_source": "BASELINE.md (reference microbenchmark @2.31.0)",
+            # The baseline numbers were published from multi-core CI
+            # machines; concurrent benchmarks (multi_client / n_n) are
+            # aggregate-CPU-bound, so the host's core count is load-
+            # bearing context for the ratios.
+            "host_cores": _os.cpu_count(),
         }
         with open(args.out, "w") as f:
             json.dump(out, f, indent=2, sort_keys=True)

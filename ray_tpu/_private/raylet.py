@@ -55,6 +55,7 @@ class NodeDaemon:
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
         self._rejoining = False
+        self._draining = False
 
         # Node-local object pool: our own namespace + pool, inherited by
         # the workers we spawn. Set BEFORE the store/transfer server are
@@ -168,6 +169,11 @@ class NodeDaemon:
                     self.store.delete(ObjectID(oid))
                 except Exception:  # noqa: BLE001
                     pass
+        elif mtype == "drain":
+            # Graceful drain: stop granting local leases and growing the
+            # pool; the head finalizes removal once we're quiet
+            # (reference: raylet drain — node_manager.h:551).
+            self._draining = True
         elif mtype == "shutdown":
             self.shutdown()
 
@@ -268,6 +274,12 @@ class NodeDaemon:
                     rec["state"] = "idle"
             return
         if mtype == "lease_worker":
+            if self._draining:
+                try:
+                    peer.reply(msg, ok=False)
+                except ConnectionLost:
+                    pass
+                return
             granted = None
             spawn_wid = None
             with self._lock:

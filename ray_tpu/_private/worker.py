@@ -258,3 +258,20 @@ def available_resources() -> Dict[str, float]:
 
 def nodes() -> List[Dict[str, Any]]:
     return global_client().cluster_info()["nodes"]
+
+
+def drain_node(
+    node_id: bytes, *, reason: str = "", deadline_s: float = 30.0
+) -> bool:
+    """Gracefully drain a node: no new work lands on it; it is removed
+    once running tasks finish, or forcibly at the deadline (reference:
+    node_manager.h:551 HandleDrainRaylet / autoscaler DrainNode)."""
+    reply = global_client().request(
+        {
+            "type": "drain_node",
+            "node_id": node_id,
+            "reason": reason,
+            "deadline_s": deadline_s,
+        }
+    )
+    return bool(reply.get("accepted"))

@@ -741,6 +741,40 @@ def main():
         t = msg["type"]
         if t == "execute_task":
             task_queue.put((msg["spec"], None))
+        elif t == "dump_stacks":
+            # Live profiling hook (reference: dashboard py-spy capture):
+            # format every thread's stack right here on the reader
+            # thread — works even when the main thread is stuck in user
+            # code, which is exactly when you want a dump.
+            import traceback as _tb
+
+            frames = sys._current_frames()
+            names = {th.ident: th.name for th in threading.enumerate()}
+            parts = []
+            for tid, frame in frames.items():
+                parts.append(
+                    f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
+                    + "".join(_tb.format_stack(frame))
+                )
+            # A dump can race CoreClient construction (the GCS learns of
+            # this worker during the handshake); wait briefly on the
+            # reader thread for main() to publish the client.
+            deadline = time.monotonic() + 2.0
+            while (
+                "boot_client" not in rt_holder
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            try:
+                rt_holder["boot_client"].send(
+                    {
+                        "type": "stack_dump",
+                        "token": msg.get("token"),
+                        "text": "".join(parts),
+                    }
+                )
+            except Exception:  # noqa: BLE001
+                pass
         elif t == "exit":
             task_queue.put((None, None))
 
@@ -752,7 +786,9 @@ def main():
 
     from .protocol import PeerConn
 
-    direct_addr = f"/tmp/rtpu-w-{worker_id.hex()[:12]}.sock"
+    # Full hex: a truncated id is NOT unique for counter-suffixed ids
+    # (ids.fast_unique_bytes shares its first 8 bytes process-wide).
+    direct_addr = f"/tmp/rtpu-w-{worker_id.hex()}.sock"
     try:
         os.unlink(direct_addr)
     except FileNotFoundError:
@@ -802,6 +838,7 @@ def main():
         address, authkey, role="worker", worker_id=worker_id,
         push_handler=push, direct_addr=direct_addr,
     )
+    rt_holder["boot_client"] = client
     raylet_addr = os.environ.get("RAY_TPU_LOCAL_RAYLET")
     if raylet_addr and os.environ.get("RAY_TPU_LOCAL_ONLY"):
         # Report our direct socket to the owning raylet so it can lease

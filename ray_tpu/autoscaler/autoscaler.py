@@ -73,6 +73,7 @@ class Autoscaler:
         self._counts: Dict[str, int] = {t: 0 for t in node_types}
         self._node_type: Dict[bytes, str] = {}
         self._idle_since: Dict[bytes, float] = {}
+        self._draining: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.num_launches = 0
@@ -146,19 +147,39 @@ class Autoscaler:
                 self._node_type[node.node_id] = t
                 self.num_launches += 1
 
-        # Terminate nodes idle beyond the timeout.
+        # Scale down nodes idle beyond the timeout: drain gracefully
+        # first (no new placements; the GCS finalizes removal when the
+        # node is quiet — reference: autoscaler DrainNode before
+        # termination), then release the provider instance.
+        from .._private.worker import global_client
+
         now = time.monotonic()
         idle = set(reply["idle_nodes"])
+        alive = {
+            n["node_id"]
+            for n in global_client().cluster_info()["nodes"]
+            if n["alive"]
+        }
         for node in list(self.provider.non_terminated_nodes()):
             nid = node.node_id
-            if nid in idle:
-                since = self._idle_since.setdefault(nid, now)
-                if now - since >= self.idle_timeout_s:
+            if nid in self._draining:
+                if nid not in alive:  # drain finalized by the GCS
                     t = self._node_type.pop(nid, None)
                     if t:
                         self._counts[t] -= 1
                     self.provider.terminate_node(node)
-                    self._idle_since.pop(nid, None)
+                    self._draining.discard(nid)
                     self.num_terminations += 1
+                continue
+            if nid in idle:
+                since = self._idle_since.setdefault(nid, now)
+                if now - since >= self.idle_timeout_s:
+                    from .._private.worker import drain_node
+
+                    drain_node(
+                        nid, reason="idle scale-down", deadline_s=30.0
+                    )
+                    self._draining.add(nid)
+                    self._idle_since.pop(nid, None)
             else:
                 self._idle_since.pop(nid, None)

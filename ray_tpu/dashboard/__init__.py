@@ -1,17 +1,29 @@
-"""Dashboard: HTTP view of cluster state.
+"""Dashboard: HTTP view of cluster state, metrics, logs, profiling.
 
 Reference: dashboard/ (aiohttp head process serving a React frontend +
-JSON APIs fed by the GCS and agents). Scoped-down equivalent: one
-aiohttp actor serving the state API as JSON under /api/* plus a
-self-contained HTML overview — the data pipeline (GCS task events →
-state API) is the same one the reference's dashboard rides.
+JSON APIs fed by the GCS and agents). Scoped-down equivalent riding the
+same data pipelines:
+
+  /                         self-contained HTML overview (tables +
+                            metric sparklines + log tail, no JS deps)
+  /api/cluster              resources
+  /api/{nodes,workers,...}  state API as JSON
+  /api/metrics_timeseries   ring buffer of sampled core gauges
+  /api/logs?prefix=&tail=   the driver log ring (log pipeline)
+  /api/profile/{worker_id}  live thread stacks from a worker
+                            (reference: reporter/profile_manager.py —
+                            sys._current_frames instead of py-spy)
+  /metrics                  Prometheus text exposition of user +
+                            core-runtime metrics (reference: the node
+                            metrics agent's Prometheus endpoint)
 
     from ray_tpu.dashboard import start_dashboard
     url = start_dashboard(port=8265)
 """
 from __future__ import annotations
 
-import json
+import time
+from collections import deque
 from typing import Optional
 
 _PAGE = """<!doctype html>
@@ -22,36 +34,74 @@ _PAGE = """<!doctype html>
  table { border-collapse: collapse; margin-top: .5rem; }
  td, th { border: 1px solid #ccc; padding: .25rem .6rem; font-size: .85rem; }
  th { background: #f3f3f3; text-align: left; }
- code { background: #f6f6f6; padding: 0 .25rem; }
+ code, pre { background: #f6f6f6; padding: 0 .25rem; }
+ pre { padding: .5rem; overflow-x: auto; max-height: 20rem; }
+ svg.spark { background: #fafafa; border: 1px solid #eee; }
+ .sparkrow { display: flex; gap: 1.5rem; flex-wrap: wrap; }
+ .sparkrow figure { margin: 0; }
+ figcaption { font-size: .75rem; color: #555; }
 </style></head>
 <body>
 <h1>ray_tpu dashboard</h1>
+<div id="charts"></div>
 <div id="root">loading…</div>
+<h2>logs (tail)</h2><pre id="logs">…</pre>
 <script>
 const KINDS = ["nodes", "workers", "actors", "tasks", "placement_groups"];
+function spark(points, label) {
+  if (!points.length) return "";
+  const w = 180, h = 40;
+  const max = Math.max(...points, 1e-9), min = Math.min(...points, 0);
+  const xs = points.map((p, i) => [
+    i * w / Math.max(points.length - 1, 1),
+    h - 2 - (p - min) / Math.max(max - min, 1e-9) * (h - 4)]);
+  const path = xs.map(([x, y], i) => (i ? "L" : "M") + x.toFixed(1) + " " + y.toFixed(1)).join(" ");
+  return `<figure><svg class="spark" width="${w}" height="${h}">` +
+    `<path d="${path}" fill="none" stroke="#36c" stroke-width="1.5"/></svg>` +
+    `<figcaption>${label} (now: ${points[points.length-1].toFixed(1)})</figcaption></figure>`;
+}
 async function refresh() {
+  const ts = await (await fetch("/api/metrics_timeseries")).json();
+  let charts = '<h2>metrics</h2><div class="sparkrow">';
+  for (const [name, pts] of Object.entries(ts.series))
+    charts += spark(pts, name);
+  document.getElementById("charts").innerHTML = charts + "</div>";
+
   const root = document.getElementById("root");
   let html = "";
-  const res = await fetch("/api/cluster"); const cluster = await res.json();
+  const cluster = await (await fetch("/api/cluster")).json();
   html += "<h2>Resources</h2><table><tr><th>resource</th><th>available</th><th>total</th></tr>";
   for (const k of Object.keys(cluster.total).sort())
     html += `<tr><td>${k}</td><td>${cluster.available[k] ?? 0}</td><td>${cluster.total[k]}</td></tr>`;
   html += "</table>";
   for (const kind of KINDS) {
-    const r = await fetch(`/api/${kind}`); const items = await r.json();
+    const items = await (await fetch(`/api/${kind}`)).json();
     html += `<h2>${kind} (${items.length})</h2>`;
     if (!items.length) { html += "<p>(none)</p>"; continue; }
     const cols = Object.keys(items[0]);
-    html += "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>";
-    for (const it of items.slice(0, 50))
-      html += "<tr>" + cols.map(c => `<td>${JSON.stringify(it[c])}</td>`).join("") + "</tr>";
+    html += "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") +
+      (kind === "workers" ? "<th>profile</th>" : "") + "</tr>";
+    for (const it of items.slice(0, 50)) {
+      html += "<tr>" + cols.map(c => `<td>${JSON.stringify(it[c])}</td>`).join("");
+      if (kind === "workers")
+        html += `<td><a href="/api/profile/${it.worker_id}">stacks</a></td>`;
+      html += "</tr>";
+    }
     html += "</table>";
   }
   root.innerHTML = html;
+  const logs = await (await fetch("/api/logs?tail=40")).json();
+  document.getElementById("logs").textContent =
+    logs.lines.map(l => `[${l[0]}|${l[1].slice(0,8)}] ${l[2]}`).join("\\n");
 }
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
 """
+
+# Core gauges sampled into the timeseries ring (2s period, ~10min of
+# history at 300 samples).
+_SAMPLE_PERIOD_S = 2.0
+_RING = 300
 
 
 class DashboardActor:
@@ -59,22 +109,77 @@ class DashboardActor:
         self._host = host
         self._port = port
         self._runner = None
+        self._ts: dict = {}  # name -> deque[(t, value)]
+        self._sampler = None
 
     async def ready(self) -> str:
         if self._runner is not None:
             return f"http://{self._host}:{self._port}"
+        import asyncio
+
         from aiohttp import web
 
         app = web.Application()
         app.router.add_get("/", self._index)
         app.router.add_get("/api/cluster", self._cluster)
+        app.router.add_get("/api/metrics_timeseries", self._timeseries)
+        app.router.add_get("/api/logs", self._logs)
+        app.router.add_get("/api/profile/{worker_id}", self._profile)
+        app.router.add_get("/metrics", self._prometheus)
         app.router.add_get("/api/{kind}", self._list)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)
         await site.start()
+        self._sampler = asyncio.ensure_future(self._sample_loop())
         return f"http://{self._host}:{self._port}"
 
+    # -------------------------------------------------------- timeseries
+    def _sample_once(self):
+        import ray_tpu
+
+        total = ray_tpu.cluster_resources()
+        avail = ray_tpu.available_resources()
+        now = time.time()
+        samples = {}
+        for k, v in total.items():
+            samples[f"{k} used"] = v - avail.get(k, 0.0)
+        samples["nodes alive"] = float(
+            sum(1 for n in ray_tpu.nodes() if n["alive"])
+        )
+        from ..util.state import list_workers
+
+        samples["workers"] = float(len(list_workers(limit=10_000)))
+        for name, v in samples.items():
+            self._ts.setdefault(name, deque(maxlen=_RING)).append((now, v))
+
+    async def _sample_loop(self):
+        import asyncio
+
+        while True:
+            try:
+                # Off the event loop: the sample does blocking GCS RPCs.
+                await asyncio.to_thread(self._sample_once)
+            except Exception:  # noqa: BLE001 - cluster may be mid-shutdown
+                pass
+            await asyncio.sleep(_SAMPLE_PERIOD_S)
+
+    async def _timeseries(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            {
+                "period_s": _SAMPLE_PERIOD_S,
+                "series": {
+                    name: [v for _, v in dq] for name, dq in self._ts.items()
+                },
+                "timestamps": {
+                    name: [t for t, _ in dq] for name, dq in self._ts.items()
+                },
+            }
+        )
+
+    # ------------------------------------------------------------- pages
     async def _index(self, request):
         from aiohttp import web
 
@@ -93,6 +198,8 @@ class DashboardActor:
         )
 
     async def _list(self, request):
+        import asyncio
+
         from aiohttp import web
 
         from ..util import state as state_api
@@ -101,9 +208,75 @@ class DashboardActor:
         fn = getattr(state_api, f"list_{kind}", None)
         if fn is None:
             return web.Response(status=404, text=f"unknown kind {kind}")
-        return web.json_response(fn(limit=500))
+        return web.json_response(await asyncio.to_thread(fn, limit=500))
+
+    # -------------------------------------------------------------- logs
+    async def _logs(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        from .._private.worker import global_client
+
+        reply = await asyncio.to_thread(
+            global_client().request,
+            {
+                "type": "get_logs",
+                "worker_prefix": request.query.get("prefix", ""),
+                "tail": int(request.query.get("tail", 200)),
+            },
+        )
+        return web.json_response({"lines": reply.get("lines", [])})
+
+    # ----------------------------------------------------------- profile
+    async def _profile(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        from .._private.worker import global_client
+
+        wid = bytes.fromhex(request.match_info["worker_id"])
+        # The GCS waiter can take up to its 10s sweep to time out —
+        # never hold the event loop for that.
+        reply = await asyncio.to_thread(
+            global_client().request,
+            {"type": "worker_stacks", "worker_id": wid},
+            15.0,
+        )
+        if not reply.get("ok"):
+            return web.Response(status=404, text=reply.get("error", "?"))
+        return web.Response(text=reply["text"], content_type="text/plain")
+
+    # -------------------------------------------------------- prometheus
+    async def _prometheus(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        from ..util.metrics import (
+            core_runtime_snapshot,
+            get_metrics_snapshot,
+            prometheus_text,
+        )
+
+        def scrape() -> str:
+            snap = get_metrics_snapshot()
+            try:
+                snap.update(core_runtime_snapshot())
+            except Exception:  # noqa: BLE001 - keep user metrics
+                pass
+            return prometheus_text(snap)
+
+        return web.Response(
+            text=await asyncio.to_thread(scrape),
+            content_type="text/plain",
+            charset="utf-8",
+        )
 
     async def shutdown(self):
+        if self._sampler:
+            self._sampler.cancel()
         if self._runner:
             await self._runner.cleanup()
 

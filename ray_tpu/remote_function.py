@@ -6,7 +6,7 @@ import functools
 from typing import Any, Dict, List, Optional, Union
 
 from ._private import submit as _submit
-from ._private.ids import PlacementGroupID, TaskID
+from ._private.ids import PlacementGroupID, TaskID, fast_unique_bytes
 from ._private.task_spec import TaskSpec
 from ._private.worker import global_client
 from .object_ref import ObjectRef
@@ -111,7 +111,10 @@ class RemoteFunction:
 
             if not tracing.enabled():
                 spec = TaskSpec.__new__(TaskSpec)
-                spec.task_id = TaskID.from_random()
+                # Syscall-free id on the steady-state path; return
+                # object ids derive from bytes [:12] which stay unique
+                # (see ids.fast_unique_bytes).
+                spec.task_id = TaskID(fast_unique_bytes())
                 spec.name = self._fn.__name__
                 spec.function_id = self._function_id
                 spec.function_blob = client.register_function_once(

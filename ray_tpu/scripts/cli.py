@@ -131,6 +131,16 @@ def cmd_status(args):
     print(f"  workers: {len(workers)}")
 
 
+def cmd_drain(args):
+    ray_tpu = _connect()
+    node_id = bytes.fromhex(args.node_id)
+    ok = ray_tpu.drain_node(
+        node_id, reason=args.reason, deadline_s=args.deadline_s
+    )
+    print("drain accepted" if ok else "drain rejected (no such node)")
+    return 0 if ok else 1
+
+
 def _print_table(items, columns):
     if not items:
         print("(none)")
@@ -302,6 +312,12 @@ def main(argv=None):
 
     sub.add_parser("stop", help="stop the head").set_defaults(fn=cmd_stop)
     sub.add_parser("status", help="cluster status").set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("drain", help="gracefully drain a node")
+    sp.add_argument("node_id", help="node id (hex, from `list nodes`)")
+    sp.add_argument("--reason", default="manual drain")
+    sp.add_argument("--deadline-s", type=float, default=30.0)
+    sp.set_defaults(fn=cmd_drain)
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["actors", "tasks", "nodes", "workers",

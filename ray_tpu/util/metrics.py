@@ -152,8 +152,155 @@ class Histogram(Metric):
             }
 
 
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    return ("_" + s) if s and s[0].isdigit() else s
+
+
+def _prom_labels(tags: Dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(
+        f'{_prom_name(k)}="{str(v).replace(chr(92), chr(92)*2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in sorted(tags.items())
+    )
+    return "{" + inner + "}"
+
+
+def prometheus_text(snapshot: Dict[str, Dict]) -> str:
+    """Render a metrics snapshot in the Prometheus text exposition
+    format (reference: the node metrics agent's OpenCensus→Prometheus
+    exporter, _private/metrics_agent.py; format spec:
+    prometheus.io/docs/instrumenting/exposition_formats)."""
+    lines: List[str] = []
+    for name, dump in sorted(snapshot.items()):
+        pname = _prom_name(name)
+        kind = dump.get("kind", "gauge")
+        prom_type = {"counter": "counter", "histogram": "histogram"}.get(
+            kind, "gauge"
+        )
+        if dump.get("description"):
+            desc = dump["description"].replace("\n", " ")
+            lines.append(f"# HELP {pname} {desc}")
+        lines.append(f"# TYPE {pname} {prom_type}")
+        if kind == "histogram":
+            bounds = dump.get("boundaries", [])
+            for s in dump.get("series", []):
+                tags = s.get("tags", {})
+                counts = s.get("counts", [])
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_prom_labels({**tags, 'le': repr(float(b))})}"
+                        f" {cum}"
+                    )
+                total = sum(counts)
+                lines.append(
+                    f"{pname}_bucket{_prom_labels({**tags, 'le': '+Inf'})}"
+                    f" {total}"
+                )
+                lines.append(
+                    f"{pname}_sum{_prom_labels(tags)} {s.get('sum', 0.0)}"
+                )
+                lines.append(f"{pname}_count{_prom_labels(tags)} {total}")
+        else:
+            for s in dump.get("series", []):
+                lines.append(
+                    f"{pname}{_prom_labels(s.get('tags', {}))}"
+                    f" {s.get('value', 0.0)}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def core_runtime_snapshot() -> Dict[str, Dict]:
+    """Built-in runtime series computed live at scrape time (reference:
+    stats/metric_defs.cc — tasks/actors/nodes/object-store gauges),
+    merged into /metrics beside user-defined metrics."""
+    from .._private.worker import global_client
+    from . import state as state_api
+
+    client = global_client()
+    info = client.cluster_info()
+    out: Dict[str, Dict] = {}
+
+    def gauge(name, desc, series):
+        out[name] = {"kind": "gauge", "description": desc, "series": series}
+
+    gauge(
+        "ray_tpu_resources_total",
+        "cluster total resources by kind",
+        [
+            {"tags": {"resource": k}, "value": v}
+            for k, v in info["total"].items()
+        ],
+    )
+    gauge(
+        "ray_tpu_resources_available",
+        "cluster available resources by kind",
+        [
+            {"tags": {"resource": k}, "value": v}
+            for k, v in info["available"].items()
+        ],
+    )
+    gauge(
+        "ray_tpu_nodes_alive",
+        "alive cluster nodes",
+        [{"tags": {}, "value": sum(1 for n in info["nodes"] if n["alive"])}],
+    )
+    workers = state_api.list_workers(limit=10_000)
+    by_state: Dict[str, int] = {}
+    for w in workers:
+        by_state[w.get("state", "?")] = by_state.get(w.get("state", "?"), 0) + 1
+    gauge(
+        "ray_tpu_workers",
+        "workers by state",
+        [
+            {"tags": {"state": s}, "value": c}
+            for s, c in sorted(by_state.items())
+        ],
+    )
+    tasks = state_api.summarize_tasks()
+    by_state: Dict[str, int] = {}
+    for states in tasks.get("by_func_name", {}).values():
+        for s, c in states.items():
+            by_state[s] = by_state.get(s, 0) + c
+    # Gauge, not counter: per-state counts shrink as tasks transition
+    # (RUNNING falls on every completion), and a shrinking counter
+    # reads as a reset to Prometheus rate().
+    out["ray_tpu_tasks"] = {
+        "kind": "gauge",
+        "description": "task events by state",
+        "series": [
+            {"tags": {"state": s}, "value": c}
+            for s, c in sorted(by_state.items())
+        ],
+    }
+    counts = client.request({"type": "msg_counts"}).get("counts", {})
+    out["ray_tpu_control_messages"] = {
+        "kind": "counter",
+        "description": "head control-plane messages by type",
+        "series": [
+            {"tags": {"type": t}, "value": c}
+            for t, c in sorted(counts.items())
+        ],
+    }
+    return out
+
+
 def get_metrics_snapshot() -> Dict[str, Dict]:
-    """Aggregate every process's published metrics from the GCS KV."""
+    """Aggregate every process's published metrics from the GCS KV.
+
+    Series from different processes that share a metric name AND tag
+    set are MERGED (summed; histograms element-wise) — the Prometheus
+    exposition format forbids duplicate samples for one labelset, and
+    "total across processes" is the useful cluster-level reading
+    (reference: the metrics agent aggregates per-worker streams the
+    same way before export)."""
     from .._private.worker import global_client
 
     client = global_client()
@@ -165,8 +312,33 @@ def get_metrics_snapshot() -> Dict[str, Dict]:
             continue
         for name, dump in json.loads(blob).items():
             slot = out.setdefault(
-                name, {"kind": dump["kind"],
-                       "description": dump["description"], "series": []}
+                name,
+                {
+                    "kind": dump["kind"],
+                    "description": dump["description"],
+                    "series": [],
+                    "_by_tags": {},
+                },
             )
-            slot["series"].extend(dump.get("series", []))
+            if "boundaries" in dump:
+                slot["boundaries"] = dump["boundaries"]
+            for s in dump.get("series", []):
+                tkey = tuple(sorted((s.get("tags") or {}).items()))
+                prev = slot["_by_tags"].get(tkey)
+                if prev is None:
+                    slot["_by_tags"][tkey] = merged = dict(s)
+                    slot["series"].append(merged)
+                elif "counts" in s:  # histogram: element-wise
+                    prev["sum"] = prev.get("sum", 0.0) + s.get("sum", 0.0)
+                    pc, sc = prev.get("counts", []), s.get("counts", [])
+                    prev["counts"] = [
+                        a + b
+                        for a, b in zip(pc, sc)
+                    ] if len(pc) == len(sc) else (pc or sc)
+                else:
+                    prev["value"] = prev.get("value", 0.0) + s.get(
+                        "value", 0.0
+                    )
+    for slot in out.values():
+        slot.pop("_by_tags", None)
     return out
