@@ -163,7 +163,18 @@ class CoreClient:
         self._record_lineage(spec)
         self.conn.send({"type": "submit_task", "spec": spec})
         owner = self.worker_id.binary()
-        return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+        refs = [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+        self._advertise_returns(refs)
+        return refs
+
+    def _advertise_returns(self, refs: Sequence[ObjectRef]) -> None:
+        """Owner-side return refs count as advertised from birth: the
+        directory frees a sealed result on the owner's remove (the
+        had_holder fast-drop path), so the drop must go out even when
+        the ref dies inside the first flush window — otherwise every
+        short-lived `get(f.remote())` result leaks server-side."""
+        for r in refs:
+            self._tracker.mark_advertised(r.id().binary())
 
     # ------------------------------------------------- leased task transport
     # Reference: CoreWorkerDirectTaskSubmitter (direct_task_transport.cc:24)
@@ -340,10 +351,11 @@ class CoreClient:
             self._direct_results[ob] = (rfut, i)
         frame = (
             OP_CALL, req_id, tid, spec.function_id, None, spec.args_blob,
-            nret, None,
+            nret, None, None,
         )
         owner = self.worker_id.binary()
         refs = [ObjectRef(ObjectID(ob), owner) for ob in oids]
+        self._advertise_returns(refs)
         try:
             conn.send_lazy(frame)
         except ConnectionLost:
@@ -453,6 +465,7 @@ class CoreClient:
         args_blob: bytes,
         num_returns: int,
         deps: Sequence[ObjectID] = (),
+        concurrency_group: Optional[str] = None,
     ) -> Optional[List[ObjectRef]]:
         """Steady-state actor call: compact frame straight down an
         established direct connection, no TaskSpec object at all.
@@ -464,12 +477,14 @@ class CoreClient:
             return None
         tid = fast_unique_bytes()
         return self._send_frame(
-            conn, aid, tid, method_name, args_blob, num_returns, deps
+            conn, aid, tid, method_name, args_blob, num_returns, deps,
+            concurrency_group,
         )
 
     def _send_frame(
         self, conn, aid: bytes, tid: bytes, method_name: str,
         args_blob: bytes, num_returns: int, deps: Sequence[ObjectID] = (),
+        concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
         oids = [
             ObjectID.bytes_for_return(tid, i) for i in range(num_returns)
@@ -492,10 +507,12 @@ class CoreClient:
         for d in dep_ids:
             self._tracker.incr(d)
         frame = (
-            OP_CALL, req_id, tid, None, method_name, args_blob, num_returns, aid,
+            OP_CALL, req_id, tid, None, method_name, args_blob, num_returns,
+            aid, concurrency_group,
         )
         owner = self.worker_id.binary()
         refs = [ObjectRef(ObjectID(ob), owner) for ob in oids]
+        self._advertise_returns(refs)
         try:
             conn.send_lazy(frame)
         except ConnectionLost:
@@ -546,7 +563,9 @@ class CoreClient:
 
     def _refs_for(self, spec: TaskSpec) -> List[ObjectRef]:
         owner = self.worker_id.binary()
-        return [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+        refs = [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
+        self._advertise_returns(refs)
+        return refs
 
     def _on_direct_resolved(self, aid: bytes, rfut):
         try:
@@ -590,6 +609,7 @@ class CoreClient:
             spec.args_blob,
             spec.num_returns,
             spec.dependencies,
+            spec.concurrency_group,
         )
 
     def _resolve_direct(self, aid: bytes, oids, rfut) -> None:
@@ -621,8 +641,14 @@ class CoreClient:
 
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID(fast_unique_bytes())
+        # Ref exists (count>=1) BEFORE the directory learns of the
+        # object, and the tracker knows the directory holds us as a
+        # holder (put_object registers the putter) — so the eventual
+        # drop sends its remove even if the add batch never went out.
+        ref = ObjectRef(oid, self.worker_id.binary())
         self.put_with_id(oid, value)
-        return ObjectRef(oid, self.worker_id.binary())
+        self._tracker.mark_advertised(oid.binary())
+        return ref
 
     def put_with_id(self, oid: ObjectID, value: Any) -> Dict[str, Any]:
         """Seal a value; small values inline through the GCS, large ones go

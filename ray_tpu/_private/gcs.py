@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import math
 import os
+import queue
 import random
 import subprocess
 import sys
@@ -293,6 +294,14 @@ class GcsServer:
         self._sched_rng = random.Random(0xC0FFEE)
         # In-flight worker stack-dump requests: token -> (peer, msg, ts).
         self._stack_waiters: Dict[str, Tuple] = {}
+        # Channelized pubsub (reference: src/ray/pubsub/publisher.h —
+        # per-channel subscriber lists; delivery is push over the
+        # already-persistent duplex conns instead of long-poll).
+        # channel -> list of peers; key filtering is client-side;
+        # fan-out runs on its own thread (never under the GCS lock).
+        self._pubsub: Dict[str, List] = {}
+        self._pub_queue: "queue.Queue" = queue.Queue()
+        self._pub_thread: Optional[threading.Thread] = None
         # Memory-pressure ladder: background spilling of cold sealed
         # objects at high pool utilization (reference:
         # local_object_manager.h:41-110) + a host-memory monitor that
@@ -786,9 +795,14 @@ class GcsServer:
             while actor.pending:
                 self._route_actor_task(actor.pending.popleft())
             self._notify_direct_waiters(actor)
+            self._publish("ACTOR", aid.hex(), {"state": "ALIVE"})
         else:
             actor.state = A_DEAD
             actor.death_reason = "creation task failed"
+            self._publish(
+                "ACTOR", aid.hex(),
+                {"state": "DEAD", "reason": "creation task failed"},
+            )
             if actor.name:
                 self.named_actors.pop(actor.name, None)
             while actor.pending:
@@ -812,6 +826,14 @@ class GcsServer:
         with self._lock:
             entry = self.objects.setdefault(msg["object_id"], ObjectEntry())
             entry.status = READY
+            # Born held by the putter: the owner's batched add may be
+            # up to a flush interval behind, and a consumer's
+            # hold-and-drop remove must not find an empty holder set
+            # in that window (its later add/remove are idempotent).
+            cid = state.get("client_id")
+            if cid is not None:
+                entry.holders.add(cid)
+                entry.had_holder = True
             entry.inline = msg.get("inline")
             entry.segment = msg.get("segment")
             entry.size = msg.get("size", 0)
@@ -1187,6 +1209,7 @@ class GcsServer:
             return
         actor.state = A_DEAD
         actor.death_reason = reason
+        self._publish("ACTOR", aid.hex(), {"state": "DEAD", "reason": reason})
         if actor.name:
             self.named_actors.pop(actor.name, None)
         while actor.pending:
@@ -1531,6 +1554,12 @@ class GcsServer:
             node_id=node.node_id.binary(),
             session_dir=self.session_dir,
         )
+        self._publish(
+            "NODE_INFO",
+            node.node_id.hex(),
+            {"state": "ALIVE", "label": node.label,
+             "resources": dict(node.total)},
+        )
 
     def _h_node_heartbeat(self, state, msg):
         with self._lock:
@@ -1773,6 +1802,72 @@ class GcsServer:
         with self._lock:
             self._log_subscribers.append(state["peer"])
         state["peer"].reply(msg, ok=True)
+
+    # ------------------------------------------------------------- pubsub
+    def _h_pubsub_subscribe(self, state, msg):
+        # Per-peer registration is channel-granular; key filtering is
+        # client-side (one process may hold several subscriptions with
+        # different prefixes on the same channel).
+        with self._lock:
+            subs = self._pubsub.setdefault(msg["channel"], [])
+            if state["peer"] not in subs:
+                subs.append(state["peer"])
+        state["peer"].reply(msg, ok=True)
+
+    def _h_pubsub_unsubscribe(self, state, msg):
+        with self._lock:
+            subs = self._pubsub.get(msg["channel"], [])
+            self._pubsub[msg["channel"]] = [
+                p for p in subs if p is not state["peer"]
+            ]
+        state["peer"].reply(msg, ok=True)
+
+    def _h_pubsub_publish(self, state, msg):
+        self._publish(msg["channel"], msg.get("key", ""), msg.get("data"))
+        state["peer"].reply(msg, ok=True)
+
+    def _publish(self, channel: str, key: str, data) -> None:
+        """Enqueue a fan-out; delivery happens on a dedicated publisher
+        thread so a wedged subscriber socket can never stall a handler
+        holding the GCS lock (reference: publisher.h per-subscriber
+        delivery with connection GC)."""
+        with self._lock:
+            if not self._pubsub.get(channel):
+                return
+        self._pub_queue.put((channel, key, data))
+        if self._pub_thread is None:
+            self._pub_thread = threading.Thread(
+                target=self._publish_loop, name="gcs-pubsub", daemon=True
+            )
+            self._pub_thread.start()
+
+    def _publish_loop(self) -> None:
+        while True:
+            item = self._pub_queue.get()
+            if item is None:
+                return
+            channel, key, data = item
+            with self._lock:
+                subs = list(self._pubsub.get(channel, ()))
+            if not subs:
+                continue
+            dead = []
+            out = {
+                "type": "pubsub", "channel": channel, "key": key,
+                "data": data,
+            }
+            for peer in subs:
+                try:
+                    peer.send(out)
+                except ConnectionLost:
+                    dead.append(peer)
+            if dead:
+                with self._lock:
+                    self._pubsub[channel] = [
+                        p
+                        for p in self._pubsub.get(channel, ())
+                        if p not in dead
+                    ]
 
     def _h_worker_stacks(self, state, msg):
         """Live thread-stack capture from a worker (reference: the
@@ -2049,6 +2144,9 @@ class GcsServer:
             ]
         for w in dead_workers:
             self._handle_worker_death(w.worker_id.binary(), reason)
+        self._publish(
+            "NODE_INFO", nid.hex(), {"state": "DEAD", "reason": reason}
+        )
         with self._lock:
             self._work.notify_all()
 
@@ -2072,6 +2170,14 @@ class GcsServer:
             node = self.nodes.get(msg["node_id"])
             if node is None or not node.alive:
                 state["peer"].reply(msg, ok=False, error="no such node")
+                return
+            if node is self.head_node:
+                # Draining the head would tear down the control plane
+                # itself (reference: the head is not drainable either —
+                # DrainNode targets raylets).
+                state["peer"].reply(
+                    msg, ok=False, error="cannot drain the head node"
+                )
                 return
             node.schedulable = False
             node.draining = True
@@ -2557,6 +2663,10 @@ class GcsServer:
                     else:
                         actor.state = A_DEAD
                         actor.death_reason = f"actor worker died: {reason}"
+                        self._publish(
+                            "ACTOR", actor.actor_id.hex(),
+                            {"state": "DEAD", "reason": actor.death_reason},
+                        )
                         if actor.name:
                             self.named_actors.pop(actor.name, None)
                         while actor.pending:
@@ -2573,6 +2683,8 @@ class GcsServer:
 
     def shutdown(self):
         self._log_monitor.stop()
+        if self._pub_thread is not None:
+            self._pub_queue.put(None)
         with self._lock:
             self._shutdown = True
             self._work.notify_all()

@@ -246,13 +246,16 @@ def bench_objects():
         f"{RESULTS['single_client_put_gigabytes']} GiB/s"
     )
 
-    refs1k = [ray_tpu.put(b"x") for _ in range(1000)]
-
+    # Match the reference's semantics exactly (ray_perf.py
+    # wait_multiple_refs): submit 1000 LIVE tasks, then drain them with
+    # successive wait(num_returns=1) calls as results arrive — this
+    # exercises in-flight readiness tracking, not a sealed-set scan.
     def wait_1k():
-        ray_tpu.wait(refs1k, num_returns=len(refs1k))
+        not_ready = [tiny_task.remote() for _ in range(1000)]
+        while not_ready:
+            _ready, not_ready = ray_tpu.wait(not_ready, num_returns=1)
 
-    timeit("single_client_wait_1k_refs", wait_1k)
-    ray_tpu.free(refs1k)
+    timeit("single_client_wait_1k_refs", wait_1k, min_time=3.0)
 
 
 def bench_placement_groups():

@@ -53,6 +53,13 @@ class RefTracker:
         # oids whose local count hit zero; the client drops lineage for
         # them at flush time.
         self._zeroed: Set[bytes] = set()
+        # oids whose presence we have ADVERTISED to the GCS. A remove is
+        # only valid after its add: a ref held and dropped within one
+        # flush window must send NOTHING — a bare remove from a client
+        # the directory never saw holding would race ahead of the real
+        # owner's still-batched add and free a live object (the
+        # intermittent cross-worker arg-resolution hang).
+        self._advertised: Set[bytes] = set()
 
     def incr(self, oid: bytes) -> None:
         with self._lock:
@@ -76,6 +83,13 @@ class RefTracker:
     def holds(self, oid: bytes) -> bool:
         with self._lock:
             return self._counts.get(oid, 0) > 0
+
+    def mark_advertised(self, oid: bytes) -> None:
+        """The directory already records this client as a holder (e.g.
+        put_object registers the putter) — the eventual drop must send
+        its remove."""
+        with self._lock:
+            self._advertised.add(oid)
 
     def _ensure_flusher(self):
         if self._flusher is None and not self._stopped:
@@ -102,7 +116,13 @@ class RefTracker:
                 return
             dirty, self._dirty = self._dirty, set()
             add = [oid for oid in dirty if self._counts.get(oid, 0) > 0]
-            remove = [oid for oid in dirty if self._counts.get(oid, 0) <= 0]
+            remove = [
+                oid
+                for oid in dirty
+                if self._counts.get(oid, 0) <= 0 and oid in self._advertised
+            ]
+            self._advertised.update(add)
+            self._advertised.difference_update(remove)
             zeroed, self._zeroed = self._zeroed, set()
         for oid in zeroed:
             client._lineage.pop(oid, None)

@@ -31,6 +31,46 @@ from typing import Any, Dict, Optional
 _NS = "__runtime_env__"
 _VALID_KEYS = {"env_vars", "working_dir", "py_modules"}
 _lock = threading.Lock()
+
+
+class RuntimeEnvPlugin:
+    """Extension point (reference: _private/runtime_env/plugin.py
+    RuntimeEnvPlugin — validate/create/modify_context). A plugin owns
+    one runtime_env key:
+
+      validate(config)            raise on bad config (driver-side)
+      package(config, client)     driver-side transform (e.g. upload)
+      create(config, client)      worker-side materialization, cached
+                                  per config hash; returns a context
+      enter(context)              mutate os.environ / sys.path for the
+                                  task (the activation wrapper restores
+                                  both wholesale afterwards)
+    """
+
+    name: str = ""
+
+    def validate(self, config: Any) -> None:  # pragma: no cover - default
+        pass
+
+    def package(self, config: Any, client) -> Any:
+        return config
+
+    def create(self, config: Any, client) -> Any:
+        return config
+
+    def enter(self, context: Any) -> None:
+        pass
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    """Register a runtime_env plugin; its name becomes a valid key."""
+    _PLUGINS[plugin.name] = plugin
+    _VALID_KEYS.add(plugin.name)
+
+
 _extracted: Dict[str, str] = {}  # uri -> local dir
 # Driver-side package cache: (path, fingerprint) -> uri, so repeated
 # .remote() calls don't re-zip the directory on the submission hot path.
@@ -60,6 +100,9 @@ def validate(runtime_env: Dict[str, Any]) -> None:
             f"Unsupported runtime_env keys {sorted(bad)}; "
             f"supported: {sorted(_VALID_KEYS)}"
         )
+    for key, plugin in _PLUGINS.items():
+        if key in runtime_env:
+            plugin.validate(runtime_env[key])
 
 
 def _zip_dir(path: str) -> bytes:
@@ -104,6 +147,9 @@ def package(runtime_env: Dict[str, Any], client) -> Dict[str, Any]:
             m if str(m).startswith("kv://") else upload(m)
             for m in out["py_modules"]
         ]
+    for key, plugin in _PLUGINS.items():
+        if key in out:
+            out[key] = plugin.package(out[key], client)
     return out
 
 
@@ -141,23 +187,56 @@ def activate(runtime_env: Optional[Dict[str, Any]], client):
     saved_env: Dict[str, Optional[str]] = {}
     saved_path = list(sys.path)
     saved_cwd = os.getcwd()
+    saved_mods = set(sys.modules)
+    entered_roots = []  # paths whose modules must not leak to other tasks
     try:
         for k, v in (runtime_env.get("env_vars") or {}).items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
         for uri in runtime_env.get("py_modules") or []:
-            sys.path.insert(0, _ensure_extracted(uri, client))
+            root = _ensure_extracted(uri, client)
+            entered_roots.append(root)
+            sys.path.insert(0, root)
         wd = runtime_env.get("working_dir")
         if wd:
             local = _ensure_extracted(wd, client)
+            entered_roots.append(local)
             sys.path.insert(0, local)
             os.chdir(local)
+        for key, plugin in _PLUGINS.items():
+            if key in runtime_env:
+                try:
+                    ctx = plugin.create(runtime_env[key], client)
+                    if isinstance(ctx, str):
+                        entered_roots.append(ctx)
+                    plugin.enter(ctx)
+                except Exception as e:
+                    from ..exceptions import RuntimeEnvSetupError
+
+                    raise RuntimeEnvSetupError(
+                        f"runtime_env plugin {key!r} failed: {e}"
+                    ) from e
         yield
     finally:
         os.chdir(saved_cwd)
         sys.path[:] = saved_path
+        # Workers are pooled: modules imported from this env's paths
+        # must not stay importable for the NEXT task (the reference gets
+        # this isolation from per-env worker pools; we get it by
+        # evicting the env's modules from the import cache).
+        for name in set(sys.modules) - saved_mods:
+            m = sys.modules.get(name)
+            f = getattr(m, "__file__", None) or ""
+            if f and any(f.startswith(r + os.sep) for r in entered_roots):
+                del sys.modules[name]
         for k, old in saved_env.items():
             if old is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = old
+
+
+# Built-in plugins register on import (pip/conda/container); placed at
+# module end so their `from .runtime_env import ...` sees a fully
+# initialized module.
+from . import runtime_env_plugins as _builtin_plugins  # noqa: E402,F401

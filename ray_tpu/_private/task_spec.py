@@ -50,6 +50,11 @@ class TaskSpec:
     actor_name: Optional[str] = None
     lifetime: Optional[str] = None
     runtime_env: Optional[Dict[str, Any]] = None
+    # Concurrency groups (reference: concurrency_group_manager.h):
+    # creation carries {group: limit}; a method call may pin itself to a
+    # group (class-declared defaults resolve worker-side).
+    concurrency_groups: Optional[Dict[str, int]] = None
+    concurrency_group: Optional[str] = None
 
     def scheduling_class(self) -> Tuple[Tuple[str, float], ...]:
         return tuple(sorted(self.resources.items()))
@@ -87,6 +92,8 @@ class TaskSpec:
                 self.actor_name,
                 self.lifetime,
                 self.runtime_env,
+                self.concurrency_groups,
+                self.concurrency_group,
             ),
         )
 
@@ -125,6 +132,8 @@ def _rebuild_spec(
     actor_name,
     lifetime,
     runtime_env,
+    concurrency_groups=None,
+    concurrency_group=None,
 ) -> TaskSpec:
     return TaskSpec(
         task_id=TaskID(task_id),
@@ -152,4 +161,6 @@ def _rebuild_spec(
         actor_name=actor_name,
         lifetime=lifetime,
         runtime_env=runtime_env,
+        concurrency_groups=concurrency_groups,
+        concurrency_group=concurrency_group,
     )

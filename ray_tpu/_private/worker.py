@@ -168,6 +168,12 @@ def _atexit_shutdown():
 
 
 def shutdown():
+    try:
+        from ..util import pubsub as _pubsub
+
+        _pubsub._reset_for_shutdown()
+    except Exception:  # noqa: BLE001
+        pass
     with _global.lock:
         if _global.client is not None and _global.mode == DRIVER_MODE:
             try:

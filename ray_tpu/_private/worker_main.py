@@ -44,7 +44,7 @@ def _spec_from_frame(frame) -> TaskSpec:
     num_returns, actor_id) instead of a pickled TaskSpec; everything
     else takes its default. __new__ + attribute stores skip the
     21-field dataclass __init__."""
-    _, _req, tid, fid, method, args_blob, nret, aid = frame
+    _, _req, tid, fid, method, args_blob, nret, aid = frame[:8]
     s = TaskSpec.__new__(TaskSpec)
     s.task_id = TaskID(tid)
     s.name = method or "task"
@@ -67,6 +67,8 @@ def _spec_from_frame(frame) -> TaskSpec:
     s.actor_name = None
     s.lifetime = None
     s.runtime_env = None
+    s.concurrency_groups = None
+    s.concurrency_group = frame[8] if len(frame) > 8 else None
     return s
 
 
@@ -145,6 +147,9 @@ class WorkerRuntime:
         self.actor_id: Optional[bytes] = None
         self.max_concurrency = 1
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._group_pools: Dict[str, ThreadPoolExecutor] = {}
+        self._method_group: Dict[str, str] = {}
+        self._group_sems: Dict[str, Any] = {}  # async actors
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
         self._done = threading.Event()
         self._done_batcher = _DoneBatcher(client)
@@ -168,8 +173,17 @@ class WorkerRuntime:
             if method is not None and asyncio.iscoroutinefunction(method):
                 self._submit_async(_spec_from_frame(frame), (peer, req_id, False))
                 return
-            if self._pool is not None:
-                self._pool.submit(
+            try:
+                pool = self._pool_for(
+                    method_name, frame[8] if len(frame) > 8 else None
+                )
+            except ValueError as e:
+                self._report_done(
+                    _spec_from_frame(frame), None, e, (peer, req_id, False)
+                )
+                return
+            if pool is not None:
+                pool.submit(
                     self._execute, _spec_from_frame(frame), (peer, req_id, False)
                 )
                 return
@@ -196,7 +210,7 @@ class WorkerRuntime:
         from .submit import _EMPTY_ARGS_BLOB
         from ..object_ref import _CaptureRefs
 
-        _, req_id, tid, fid, method, args_blob, nret, aid = frame
+        _, req_id, tid, fid, method, args_blob, nret, aid = frame[:8]
         name = method or "task"
         with self._exec_lock:
             try:
@@ -329,7 +343,38 @@ class WorkerRuntime:
             self.actor_instance = cls(*args, **kwargs)
             self.actor_id = spec.actor_id.binary()
             self.max_concurrency = spec.max_concurrency
-            if self.max_concurrency > 1:
+            if spec.concurrency_groups:
+                # Named concurrency groups (reference:
+                # concurrency_group_manager.h): one bounded executor per
+                # group + a default executor; methods bind to groups via
+                # @ray_tpu.method(concurrency_group=...) on the class or
+                # per-call .options(concurrency_group=...).
+                self._group_limits = dict(spec.concurrency_groups)
+                self._group_pools = {
+                    g: ThreadPoolExecutor(
+                        max_workers=max(1, int(limit)),
+                        thread_name_prefix=f"cg-{g}",
+                    )
+                    for g, limit in spec.concurrency_groups.items()
+                }
+                self._pool = ThreadPoolExecutor(
+                    max_workers=max(1, self.max_concurrency),
+                    thread_name_prefix="cg-default",
+                )
+                self._method_group = {}
+                for mname in dir(cls):
+                    m = getattr(cls, mname, None)
+                    g = getattr(m, "__ray_method_options__", {}).get(
+                        "concurrency_group"
+                    ) if m is not None else None
+                    if g is not None:
+                        if g not in self._group_pools:
+                            raise ValueError(
+                                f"method {mname!r} names undeclared "
+                                f"concurrency group {g!r}"
+                            )
+                        self._method_group[mname] = g
+            elif self.max_concurrency > 1:
                 self._pool = ThreadPoolExecutor(max_workers=self.max_concurrency)
             return None
         if spec.actor_id is not None:
@@ -446,19 +491,54 @@ class WorkerRuntime:
 
         asyncio.run_coroutine_threadsafe(stream_runner(), self._aio_loop)
 
+    def _pool_for(self, method_name: str, explicit: Optional[str] = None):
+        """The executor a threaded actor method runs on: its declared
+        (or per-call) concurrency group's pool, else the default. An
+        explicit per-call group that was never declared is an error —
+        silently falling back would drop the intended limit."""
+        if self._group_pools:
+            g = explicit or self._method_group.get(method_name)
+            if g is not None:
+                pool = self._group_pools.get(g)
+                if pool is None:
+                    raise ValueError(
+                        f"concurrency group {g!r} not declared on this "
+                        f"actor (declared: {sorted(self._group_pools)})"
+                    )
+                return pool
+        elif explicit is not None:
+            raise ValueError(
+                f"concurrency group {explicit!r}: actor has no "
+                "concurrency_groups"
+            )
+        return self._pool
+
     def _submit_async(self, spec: TaskSpec, origin=None):
         """Run a coroutine method on the actor's event loop without blocking
         the dispatch thread — async actor calls execute concurrently
-        (reference: fiber-based async actors, transport/fiber.h:17)."""
+        (reference: fiber-based async actors, transport/fiber.h:17).
+        Concurrency groups bound by asyncio.Semaphore per group."""
         if self._aio_loop is None:
             self._aio_loop = asyncio.new_event_loop()
             threading.Thread(
                 target=self._aio_loop.run_forever, name="actor-aio", daemon=True
             ).start()
+        group = spec.concurrency_group or self._method_group.get(
+            spec.method_name
+        )
+        limits = self._group_limits if hasattr(self, "_group_limits") else {}
 
         async def runner():
             args, kwargs = self._resolve_args(spec)
             method = getattr(self.actor_instance, spec.method_name)
+            if group is not None and group in limits:
+                sem = self._group_sems.get(group)
+                if sem is None:
+                    sem = self._group_sems[group] = asyncio.Semaphore(
+                        max(1, int(limits[group]))
+                    )
+                async with sem:
+                    return await method(*args, **kwargs)
             return await method(*args, **kwargs)
 
         fut = asyncio.run_coroutine_threadsafe(runner(), self._aio_loop)
@@ -720,8 +800,15 @@ class WorkerRuntime:
                     # actor's event loop; dispatch stays free.
                     self._submit_stream_async(spec, origin)
                     continue
-                if self._pool is not None:
-                    self._pool.submit(self._execute, spec, origin)
+                try:
+                    pool = self._pool_for(
+                        spec.method_name, spec.concurrency_group
+                    )
+                except ValueError as e:
+                    self._report_done(spec, None, e, origin)
+                    continue
+                if pool is not None:
+                    pool.submit(self._execute, spec, origin)
                     continue
             with self._exec_lock:
                 self._execute(spec, origin)

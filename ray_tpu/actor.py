@@ -32,6 +32,7 @@ _VALID_ACTOR_OPTIONS = {
     "max_restarts",
     "max_task_retries",
     "max_concurrency",
+    "concurrency_groups",
     "get_if_exists",
     "scheduling_strategy",
     "placement_group",
@@ -41,14 +42,21 @@ _VALID_ACTOR_OPTIONS = {
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, *, num_returns: Optional[int] = None, name: Optional[str] = None):
+    def options(self, *, num_returns: Optional[int] = None,
+                name: Optional[str] = None,
+                concurrency_group: Optional[str] = None):
         return ActorMethod(
-            self._handle, self._method_name, num_returns or self._num_returns
+            self._handle, self._method_name,
+            num_returns or self._num_returns,
+            concurrency_group or self._concurrency_group,
         )
 
     def bind(self, *args, **kwargs):
@@ -71,13 +79,16 @@ class ActorMethod:
             )
         # Steady state: compact frame straight down the established
         # direct connection — no TaskSpec, no GCS hop (reference: actor
-        # calls go gRPC straight to the actor process).
+        # calls go gRPC straight to the actor process). Frames carry a
+        # per-call concurrency-group override; class-declared groups
+        # resolve worker-side.
         refs = client.call_actor_fast(
             self._handle._actor_id.binary(),
             self._method_name,
             args_blob,
             self._num_returns,
             deps,
+            self._concurrency_group,
         )
         if refs is None:
             spec = TaskSpec(
@@ -91,6 +102,7 @@ class ActorMethod:
                 resources={},
                 actor_id=self._handle._actor_id,
                 method_name=self._method_name,
+                concurrency_group=self._concurrency_group,
             )
             # Route resolution / buffering path; None means route via
             # the GCS (restartable actors, actor pending, remote node).
@@ -220,6 +232,7 @@ class ActorClass:
             actor_id=actor_id,
             max_restarts=opts.get("max_restarts", 0) or 0,
             max_concurrency=opts.get("max_concurrency", 1) or 1,
+            concurrency_groups=opts.get("concurrency_groups"),
             actor_name=name,
             lifetime=opts.get("lifetime"),
             placement_group_id=pg_id,

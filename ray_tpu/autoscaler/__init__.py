@@ -12,5 +12,19 @@ real TPU provider would create pod-slice VMs instead.
 from __future__ import annotations
 
 from .autoscaler import Autoscaler, NodeProvider, FakeNodeProvider  # noqa: F401
+from .v2 import (  # noqa: F401
+    CloudProvider,
+    InstanceManager,
+    ProcessCloudProvider,
+    Reconciler,
+)
 
-__all__ = ["Autoscaler", "NodeProvider", "FakeNodeProvider"]
+__all__ = [
+    "Autoscaler",
+    "CloudProvider",
+    "FakeNodeProvider",
+    "InstanceManager",
+    "NodeProvider",
+    "ProcessCloudProvider",
+    "Reconciler",
+]

@@ -22,6 +22,7 @@ same data pipelines:
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Optional
@@ -110,6 +111,9 @@ class DashboardActor:
         self._port = port
         self._runner = None
         self._ts: dict = {}  # name -> deque[(t, value)]
+        # Sampler runs in a to_thread worker while handlers iterate on
+        # the event loop — guard both.
+        self._ts_lock = threading.Lock()
         self._sampler = None
 
     async def ready(self) -> str:
@@ -150,8 +154,11 @@ class DashboardActor:
         from ..util.state import list_workers
 
         samples["workers"] = float(len(list_workers(limit=10_000)))
-        for name, v in samples.items():
-            self._ts.setdefault(name, deque(maxlen=_RING)).append((now, v))
+        with self._ts_lock:
+            for name, v in samples.items():
+                self._ts.setdefault(name, deque(maxlen=_RING)).append(
+                    (now, v)
+                )
 
     async def _sample_loop(self):
         import asyncio
@@ -167,15 +174,18 @@ class DashboardActor:
     async def _timeseries(self, request):
         from aiohttp import web
 
+        with self._ts_lock:
+            series = {
+                name: [v for _, v in dq] for name, dq in self._ts.items()
+            }
+            stamps = {
+                name: [t for t, _ in dq] for name, dq in self._ts.items()
+            }
         return web.json_response(
             {
                 "period_s": _SAMPLE_PERIOD_S,
-                "series": {
-                    name: [v for _, v in dq] for name, dq in self._ts.items()
-                },
-                "timestamps": {
-                    name: [t for t, _ in dq] for name, dq in self._ts.items()
-                },
+                "series": series,
+                "timestamps": stamps,
             }
         )
 

@@ -137,6 +137,8 @@ class RemoteFunction:
                 spec.actor_name = None
                 spec.lifetime = None
                 spec.runtime_env = None
+                spec.concurrency_groups = None
+                spec.concurrency_group = None
                 refs = client.submit_task_leased(spec)
                 if refs is None:
                     refs = client.submit(spec)

@@ -192,3 +192,31 @@ def test_reconstruction_when_node_dies_with_only_copy():
         assert np.allclose(got, expected)
     finally:
         cluster.shutdown()
+
+
+def test_fast_dropped_result_is_reclaimed(ray_start):
+    """A task result whose ref lives for less than one ref-flush window
+    must still be freed server-side (owner return-refs are advertised
+    at submission, so the drop's remove always goes out)."""
+    import time
+
+    from ray_tpu._private.worker import _global
+
+    @ray_tpu.remote
+    def quick():
+        return list(range(1000))
+
+    oids = []
+    for _ in range(5):
+        ref = quick.remote()
+        assert len(ray_tpu.get(ref)) == 1000
+        oids.append(ref.id().binary())
+        del ref  # dropped well inside the 100ms flush window
+    gcs = _global.node.gcs
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        live = [o for o in oids if gcs.objects.get(o) is not None]
+        if not live:
+            break
+        time.sleep(0.2)
+    assert not live, f"{len(live)} fast-dropped results leaked"

@@ -77,7 +77,7 @@ def test_py_modules(cluster, tmp_path):
 def test_invalid_runtime_env_key(cluster):
     with pytest.raises(ValueError, match="Unsupported runtime_env"):
 
-        @ray_tpu.remote(runtime_env={"pip": ["torch"]})
+        @ray_tpu.remote(runtime_env={"no_such_plugin": ["x"]})
         def f():
             return 1
 
@@ -112,3 +112,89 @@ def test_tpu_visible_chips_bounds():
     M.set_visible_accelerator_ids(env, ["0", "1"])
     assert env["TPU_VISIBLE_CHIPS"] == "0,1"
     assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,2,1"
+
+
+# ----------------------------------------------------- plugins (pip etc.)
+def _make_wheel(tmp_path, name="tinypkg", version="1.0", body="VALUE = 42\n"):
+    """Hand-rolled wheel (a zip with dist-info) — installable offline."""
+    import base64
+    import hashlib
+    import zipfile
+
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    dist = f"{name}-{version}.dist-info"
+    files = {
+        f"{name}/__init__.py": body,
+        f"{dist}/METADATA": (
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n"
+        ),
+        f"{dist}/WHEEL": (
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n"
+        ),
+    }
+    records = []
+    with zipfile.ZipFile(whl, "w") as zf:
+        for path, content in files.items():
+            data = content.encode()
+            zf.writestr(path, data)
+            digest = base64.urlsafe_b64encode(
+                hashlib.sha256(data).digest()
+            ).rstrip(b"=").decode()
+            records.append(f"{path},sha256={digest},{len(data)}")
+        records.append(f"{dist}/RECORD,,")
+        zf.writestr(f"{dist}/RECORD", "\n".join(records) + "\n")
+    return str(whl)
+
+
+def test_pip_plugin_venv_isolation(cluster, tmp_path):
+    """A pip runtime_env installs into a cached venv whose packages are
+    importable ONLY inside tasks carrying that env (reference:
+    _private/runtime_env/pip.py)."""
+    wheel = _make_wheel(tmp_path)
+
+    @ray_tpu.remote
+    def with_pkg():
+        import tinypkg
+
+        return tinypkg.VALUE
+
+    @ray_tpu.remote
+    def without_pkg():
+        try:
+            import tinypkg  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    env = {"pip": [wheel]}
+    assert ray_tpu.get(
+        with_pkg.options(runtime_env=env).remote(), timeout=120
+    ) == 42
+    assert ray_tpu.get(without_pkg.remote(), timeout=60) == "isolated"
+
+
+def test_pip_plugin_bad_requirement_fails_loudly(cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.exceptions.RayTaskError) as ei:
+        ray_tpu.get(
+            f.options(
+                runtime_env={"pip": ["/nonexistent/nowhere-9.9.whl"]}
+            ).remote(),
+            timeout=120,
+        )
+    assert "pip" in str(ei.value)
+
+
+def test_container_plugin_gated(cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    # No docker/podman on this host: rejected at validation time.
+    with pytest.raises(ValueError):
+        f.options(runtime_env={"container": {"image": "x"}}).remote()
