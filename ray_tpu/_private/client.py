@@ -883,12 +883,21 @@ class CoreClient:
                 if (f := self._direct_results.get(oid)) is not None
                 and self._entry_done(f)
             }
+            if len(direct_ready) >= num_returns:
+                # Enough locally-resolved direct results: no directory
+                # round-trip needed (the steady-state wait-loop case —
+                # drain-by-wait over leased-task results never touches
+                # the head once results start landing).
+                ready_set = direct_ready
+            else:
+                reply = self.conn.request(
+                    {"type": "check_ready", "object_ids": ids}
+                )
+                ready_set = set(reply["ready"]) | direct_ready
             has_direct_pending = any(
                 oid in self._direct_results and oid not in direct_ready
                 for oid in ids
             )
-            reply = self.conn.request({"type": "check_ready", "object_ids": ids})
-            ready_set = set(reply["ready"]) | direct_ready
             if len(ready_set) >= num_returns or (
                 deadline is not None and time.monotonic() >= deadline
             ):

@@ -7,7 +7,10 @@ synthetic node view plus end-to-end placement assertions on a virtual
 multi-node cluster (placement observed through the per-node resource
 view, since virtual nodes share one host).
 """
+import os
+import tempfile
 import time
+import uuid
 
 import pytest
 
@@ -111,24 +114,44 @@ def _hold(sec: float):
     return "ok"
 
 
+@ray_tpu.remote
+def _hold_until(path: str):
+    """Holds its CPU until the release file appears."""
+    while not os.path.exists(path):
+        time.sleep(0.05)
+    return "ok"
+
+
 def test_spread_strategy_spreads_tasks(three_nodes):
     before = _avail_by_label()
+    # Flag-gated holds: placement sticks only once a worker exists, and
+    # worker cold-start under a loaded host can take >10s per node — the
+    # holds must outlive the slowest spawn so all 6 placements overlap
+    # observably, then release instantly once asserted.
+    flag = os.path.join(
+        tempfile.gettempdir(), f"spread-release-{uuid.uuid4().hex}"
+    )
     refs = [
-        _hold.options(scheduling_strategy="SPREAD").remote(8.0)
+        _hold_until.options(scheduling_strategy="SPREAD").remote(flag)
         for _ in range(6)
     ]
-    # Wait until all 6 are holding CPUs somewhere (worker cold-start on
-    # the two fresh nodes delays placement by a few seconds).
-    deadline = time.time() + 20
-    while time.time() < deadline:
-        used = _block_marker(before)
-        if sum(used.values()) >= 6:
-            break
-        time.sleep(0.1)
-    used = _block_marker(before)
-    # SPREAD: 6 tasks over 3 four-CPU nodes → every node took exactly 2.
-    assert all(v == 2 for v in used.values()), used
-    ray_tpu.get(refs)
+    try:
+        deadline = time.time() + 60
+        used = {}
+        while time.time() < deadline:
+            used = _block_marker(before)
+            if sum(used.values()) >= 6:
+                break
+            time.sleep(0.1)
+        # SPREAD: 6 tasks over 3 four-CPU nodes → every node took two.
+        assert all(v == 2 for v in used.values()), used
+    finally:
+        with open(flag, "w") as f:
+            f.write("go")
+        try:
+            ray_tpu.get(refs, timeout=30)
+        finally:
+            os.unlink(flag)
 
 
 def test_default_hybrid_packs_first_node(three_nodes):
