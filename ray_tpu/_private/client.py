@@ -13,6 +13,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import serialization
+from . import fastpath as _fastpath
 from .config import RayConfig
 from .ids import ObjectID, WorkerID, fast_unique_bytes
 from .object_store import ObjectStore
@@ -22,6 +23,12 @@ from ..exceptions import GetTimeoutError, RayTaskError, RayTpuError
 from ..object_ref import ObjectRef
 
 _MISSING = object()  # direct-route state: never looked up
+_fp = _fastpath.get()  # native hot path (None → pure Python)
+_return_oids = (
+    _fp.return_oids
+    if _fp is not None
+    else lambda tid, n: [ObjectID.bytes_for_return(tid, i) for i in range(n)]
+)
 _LEASE_PIPELINE_MAX = 16  # max in-flight tasks per leased worker
 _LEASE_IDLE_RETURN_S = 0.5  # idle leases are given back after this
 _FLUSH_INTERVAL_S = 0.002  # safety flush for lazily-buffered sends
@@ -46,7 +53,12 @@ class CoreClient:
         self.store = ObjectStore()
         self._push_handler = push_handler or (lambda msg: None)
         conn = transport.connect(address, authkey)
-        self.conn = PeerConn(conn, push_handler=self._on_push, name=f"client-{role}")
+        self.conn = PeerConn(
+            conn,
+            push_handler=self._on_push,
+            on_close=self._on_head_conn_close,
+            name=f"client-{role}",
+        )
         hello = {
             "type": "hello",
             "role": role,
@@ -108,11 +120,27 @@ class CoreClient:
         # and by a safety timer for fire-and-forget callers.
         self._lazy_conns: set = set()
         self._lazy_flusher: Optional[threading.Thread] = None
+        self._lazy_evt = threading.Event()
+        self._lazy_parked = False
+        # Push-based wait (reference: raylet/wait_manager.h — waits are
+        # registered once and completed by callbacks, never polled).
+        # _wait_ready is a monotone set of locally-known-ready ids fed by
+        # (a) direct-call reply callbacks and (b) one-shot GCS
+        # subscriptions answered with ("RDY", oids) pushes; wait() just
+        # partitions against it under the condvar. Pruned when refs die.
+        self._wait_cond = threading.Condition()
+        self._wait_ready: set = set()
+        self._wait_interest: set = set()  # ids a wait() is blocked on
+        self._wait_subscribed: set = set()  # ids subscribed at the GCS
+        self._head_conn_lost = False
 
     # --------------------------------------------------------- lazy flushing
 
     def _mark_lazy(self, conn: PeerConn) -> None:
         self._lazy_conns.add(conn)
+        if self._lazy_parked:
+            self._lazy_parked = False
+            self._lazy_evt.set()
         if self._lazy_flusher is None:
             self._lazy_flusher = threading.Thread(
                 target=self._lazy_flush_loop, name="lazy-flusher", daemon=True
@@ -120,23 +148,137 @@ class CoreClient:
             self._lazy_flusher.start()
 
     def flush_lazy(self) -> None:
-        for c in list(self._lazy_conns):
-            if c.closed:
+        # Hot path (runs before every blocking get/wait): flush() itself
+        # early-outs on an empty buffer, so one call per conn is cheap.
+        for c in tuple(self._lazy_conns):
+            try:
+                c.flush()
+            except ConnectionLost:
                 self._lazy_conns.discard(c)
-                continue
-            if c.has_buffered:
-                try:
-                    c.flush()
-                except ConnectionLost:
+            else:
+                if c.closed:
                     self._lazy_conns.discard(c)
 
     def _lazy_flush_loop(self) -> None:
+        # Safety flush for fire-and-forget senders, parked while no conn
+        # has buffered frames — an idle process must cost zero wakeups
+        # (hundreds of workers x a 2 ms timer would saturate a core on
+        # their own; see the 150-actor scale stress).
         while not self.conn.closed:
-            time.sleep(_FLUSH_INTERVAL_S)
-            self.flush_lazy()
+            busy = False
+            for c in tuple(self._lazy_conns):
+                if c.has_buffered:
+                    busy = True
+                    break
+            if busy:
+                time.sleep(_FLUSH_INTERVAL_S)
+                self.flush_lazy()
+                continue
+            self._lazy_parked = True
+            # Re-check under the parked flag: a send_lazy racing the
+            # scan above sees parked=True and sets the event.
+            if any(c.has_buffered for c in tuple(self._lazy_conns)):
+                self._lazy_parked = False
+                continue
+            self._lazy_evt.wait()
+            self._lazy_evt.clear()
+            self._lazy_parked = False
+
+    def _on_head_conn_close(self) -> None:
+        # Blocked waiters must observe head loss (the old polling wait
+        # raised out of its per-iteration request; push-based waits
+        # would otherwise sleep forever on the condvar).
+        with self._wait_cond:
+            self._head_conn_lost = True
+            self._wait_cond.notify_all()
 
     def _on_push(self, msg: Dict[str, Any]):
+        if type(msg) is tuple and msg[0] == "RDY":
+            self._wait_mark(msg[1], subscribed=True)
+            return
         self._push_handler(msg)
+
+    # -------------------------------------------------- push-based wait state
+
+    def _wait_mark(self, oids, subscribed: bool = False) -> None:
+        """A result landed: promote interested ids to the ready set.
+
+        Ids without registered interest are ignored (wait() classifies
+        already-done entries itself), keeping the ready set bounded by
+        what has actually been waited on."""
+        cond = self._wait_cond
+        with cond:
+            interest = self._wait_interest
+            if subscribed:
+                hit = [o for o in oids if o in self._wait_subscribed]
+            else:
+                if not interest:
+                    return
+                hit = [o for o in oids if o in interest]
+            if not hit:
+                return
+            interest.difference_update(hit)
+            self._wait_ready.update(hit)
+            cond.notify_all()
+
+    def _wait_on_failure(self, oids) -> None:
+        """A direct route died and its entries were rewritten to
+        sentinels: re-classify interested ids — terminal sentinels are
+        ready, via_gcs resubmissions move to a GCS subscription."""
+        to_subscribe = []
+        cond = self._wait_cond
+        with cond:
+            interest = self._wait_interest
+            if not interest:
+                return
+            woke = False
+            for oid in oids:
+                if oid not in interest:
+                    continue
+                entry = self._direct_results.get(oid)
+                if isinstance(entry, dict) and entry.get("via_gcs"):
+                    if oid not in self._wait_subscribed:
+                        self._wait_subscribed.add(oid)
+                        to_subscribe.append(oid)
+                else:
+                    # FAILED / exception sentinel (or a racing success):
+                    # counts as ready; get() surfaces the outcome.
+                    interest.discard(oid)
+                    self._wait_ready.add(oid)
+                    woke = True
+            if woke:
+                cond.notify_all()
+        if to_subscribe:
+            self._wait_subscribe_async(to_subscribe)
+
+    def _wait_subscribe_async(self, oids) -> None:
+        fut = self.conn.request_async(
+            {"type": "wait_subscribe", "object_ids": oids}
+        )
+
+        def _done(f):
+            try:
+                ready = f.result().get("ready")
+            except BaseException:  # noqa: BLE001 - conn loss ends waits
+                return
+            if ready:
+                self._wait_mark(ready, subscribed=True)
+
+        fut.add_done_callback(_done)
+
+    def _wait_prune(self, oids) -> None:
+        """Refs died locally: forget their wait bookkeeping."""
+        cond = self._wait_cond
+        with cond:
+            if (
+                not self._wait_ready
+                and not self._wait_interest
+                and not self._wait_subscribed
+            ):
+                return
+            self._wait_ready.difference_update(oids)
+            self._wait_interest.difference_update(oids)
+            self._wait_subscribed.difference_update(oids)
 
     # ------------------------------------------------------------------ submit
 
@@ -341,7 +483,7 @@ class CoreClient:
         conn: PeerConn = lease["conn"]
         tid = spec.task_id._bytes
         nret = spec.num_returns
-        oids = [ObjectID.bytes_for_return(tid, i) for i in range(nret)]
+        oids = _return_oids(tid, nret)
         lineage = self._lineage
         for ob in oids:
             lineage[ob] = spec
@@ -377,6 +519,7 @@ class CoreClient:
             self._leased_conn_lost(lease, spec, oids, delivered=True)
             return
         self._dec_lease(lease)
+        self._wait_mark(oids)
 
     def _leased_conn_lost(self, lease, spec: TaskSpec, oids, delivered: bool):
         give_back = False
@@ -403,6 +546,7 @@ class CoreClient:
             )
             for ob in oids:
                 self._direct_results[ob] = {"status": "FAILED", "error": blob}
+            self._wait_on_failure(oids)
             return
         if delivered:
             spec.max_retries -= 1
@@ -412,6 +556,7 @@ class CoreClient:
             self.conn.send({"type": "submit_task", "spec": spec})
         except ConnectionLost:
             pass
+        self._wait_on_failure(oids)
 
     def _dec_lease(self, lease):
         with self._lease_lock:
@@ -486,9 +631,7 @@ class CoreClient:
         args_blob: bytes, num_returns: int, deps: Sequence[ObjectID] = (),
         concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
-        oids = [
-            ObjectID.bytes_for_return(tid, i) for i in range(num_returns)
-        ]
+        oids = _return_oids(tid, num_returns)
         req_id = conn.next_req_id()
         rfut = conn.register_future(req_id)
         with self._direct_lock:
@@ -622,6 +765,7 @@ class CoreClient:
             pending = self._direct_oids.get(aid)
             if pending is not None:
                 pending.difference_update(oids)
+        self._wait_mark(oids)
 
     def _on_direct_close(self, aid: bytes) -> None:
         from ..exceptions import ActorDiedError
@@ -636,6 +780,8 @@ class CoreClient:
                             reason="actor connection lost"
                         )
                     }
+        if pending:
+            self._wait_on_failure(pending)
 
     # ------------------------------------------------------------------ objects
 
@@ -858,72 +1004,140 @@ class CoreClient:
             out.append(self._materialize_or_reconstruct(fields, ref, remaining))
         return out
 
-    @staticmethod
-    def _entry_done(entry) -> bool:
-        if type(entry) is tuple:
-            return entry[0].done()
-        return True  # resolved sentinel dict
-
     def wait(
         self,
         refs: Sequence[ObjectRef],
         num_returns: int = 1,
         timeout: Optional[float] = None,
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
-        ids = [r.id().binary() for r in refs]
+        """Push-based wait: zero head round-trips in steady state.
+
+        Each id is classified ONCE (across all wait calls on it): ids
+        with an in-flight direct future get completion callbacks, the
+        rest are covered by a single GCS subscription whose readiness
+        arrives as ("RDY", ids) pushes. After that, every wait() call is
+        a pure in-process partition against the ready set under a
+        condvar — the drain-by-wait loop (reference ray_perf
+        wait_multiple_refs) costs O(n) set lookups per call and no wire
+        traffic (reference: raylet/wait_manager.h)."""
+        refs = list(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
         self.flush_lazy()
-        while True:
-            # Direct call results resolve on the direct socket; the
-            # GCS only learns of them via the worker's batched task_done —
-            # count locally-done entries as ready immediately.
-            direct_ready = {
-                oid
-                for oid in ids
-                if (f := self._direct_results.get(oid)) is not None
-                and self._entry_done(f)
-            }
-            if len(direct_ready) >= num_returns:
-                # Enough locally-resolved direct results: no directory
-                # round-trip needed (the steady-state wait-loop case —
-                # drain-by-wait over leased-task results never touches
-                # the head once results start landing).
-                ready_set = direct_ready
-            else:
-                reply = self.conn.request(
-                    {"type": "check_ready", "object_ids": ids}
-                )
-                ready_set = set(reply["ready"]) | direct_ready
-            has_direct_pending = any(
-                oid in self._direct_results and oid not in direct_ready
-                for oid in ids
+        cond = self._wait_cond
+        ready_set = self._wait_ready
+        interest = self._wait_interest
+        direct = self._direct_results
+        to_subscribe: List[bytes] = []
+        with cond:
+            for r in refs:
+                oid = r._id._bytes
+                if oid in ready_set or oid in interest:
+                    continue
+                entry = direct.get(oid)
+                if entry is None:
+                    # GCS-routed (task result, put, foreign ref):
+                    # subscribe once; the head replies with the already-
+                    # sealed subset and pushes the rest as they seal.
+                    interest.add(oid)
+                    if oid not in self._wait_subscribed:
+                        self._wait_subscribed.add(oid)
+                        to_subscribe.append(oid)
+                elif type(entry) is tuple:
+                    fut = entry[0]
+                    if fut.done() and fut.exception() is None:
+                        ready_set.add(oid)
+                    else:
+                        # In flight (or failing): _resolve_leased/
+                        # _resolve_direct mark success, the conn-lost
+                        # handlers re-classify through _wait_on_failure.
+                        interest.add(oid)
+                else:
+                    # Sentinel dict: resolved locally, unless the task
+                    # was resubmitted through the GCS.
+                    if entry.get("via_gcs"):
+                        interest.add(oid)
+                        if oid not in self._wait_subscribed:
+                            self._wait_subscribed.add(oid)
+                            to_subscribe.append(oid)
+                    else:
+                        ready_set.add(oid)
+        if to_subscribe:
+            # Synchronous: the old check_ready always performed one
+            # readiness round-trip even with timeout=0 — "check once"
+            # callers must see objects already sealed at the GCS.
+            reply = self.conn.request(
+                {"type": "wait_subscribe", "object_ids": to_subscribe}
             )
-            if len(ready_set) >= num_returns or (
-                deadline is not None and time.monotonic() >= deadline
-            ):
-                ready = [r for r in refs if r.id().binary() in ready_set][:num_returns]
-                ready_ids = {r.id().binary() for r in ready}
-                rest = [r for r in refs if r.id().binary() not in ready_ids]
-                return ready, rest
-            pending_ids = [i for i in ids if i not in ready_set]
-            block = None if deadline is None else max(0.0, deadline - time.monotonic())
-            if has_direct_pending:
-                # A direct future completing won't wake the GCS park; poll.
-                block = 0.05 if block is None else min(block, 0.05)
-            try:
-                self.conn.request(
-                    {"type": "wait_any", "object_ids": pending_ids}, timeout=block
-                )
-            except TimeoutError:
-                pass
+            already = reply.get("ready")
+            if already:
+                self._wait_mark(already, subscribed=True)
+        while True:
+            with cond:
+                if self._head_conn_lost:
+                    raise ConnectionLost("GCS connection lost during wait")
+                if num_returns == 1:
+                    # Drain-loop fast path: results complete roughly in
+                    # submission order, so the first ready ref sits near
+                    # the front — scan to it (no per-element appends) and
+                    # build the rest as two C-level slices.
+                    hit = -1
+                    i = 0
+                    for r in refs:
+                        if r._id._bytes in ready_set:
+                            hit = i
+                            break
+                        i += 1
+                    if hit >= 0:
+                        return [refs[hit]], refs[:hit] + refs[hit + 1:]
+                elif _fp is not None:
+                    part = _fp.wait_partition(refs, ready_set, num_returns)
+                    if part is not None:
+                        return part
+                else:
+                    part = self._wait_split(refs, num_returns)
+                    if part is not None:
+                        return part
+                if deadline is None:
+                    cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        # Timed out: partial result — whatever is ready
+                        # (fewer than num_returns), rest unchanged.
+                        ready = [
+                            r for r in refs if r._id._bytes in ready_set
+                        ][:num_returns]
+                        got = {id(r) for r in ready}
+                        rest = [r for r in refs if id(r) not in got]
+                        return ready, rest
+                    cond.wait(remaining)
+
+    def _wait_split(
+        self, refs, num_returns: int
+    ) -> Optional[Tuple[List[ObjectRef], List[ObjectRef]]]:
+        """Partition refs against the ready set; None if not enough
+        ready yet (caller holds the wait condvar)."""
+        ready_set = self._wait_ready
+        ready: List[ObjectRef] = []
+        rest: List[ObjectRef] = []
+        nready = 0
+        for r in refs:
+            if nready < num_returns and r._id._bytes in ready_set:
+                ready.append(r)
+                nready += 1
+            else:
+                rest.append(r)
+        if nready < num_returns:
+            return None
+        return ready, rest
 
     def free(self, refs: Sequence[ObjectRef]):
+        ids = [r.id().binary() for r in refs]
         with self._direct_lock:
-            for r in refs:
-                self._direct_results.pop(r.id().binary(), None)
-        self.conn.send(
-            {"type": "free_objects", "object_ids": [r.id().binary() for r in refs]}
-        )
+            for oid in ids:
+                self._direct_results.pop(oid, None)
+        self._wait_prune(ids)
+        self.conn.send({"type": "free_objects", "object_ids": ids})
         # Drop our local copies (pulled replicas / remote-driver puts);
         # the GCS fan-out only reaches node daemons, not this process.
         for r in refs:

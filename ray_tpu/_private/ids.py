@@ -27,12 +27,24 @@ _uniq_count = itertools.count(1)
 _pack_q = struct.Struct("<Q").pack
 
 
+def _reseed_after_fork() -> None:
+    """Forked children (zygote fast-spawn path) MUST NOT inherit the
+    prefix+counter: two forked workers would mint identical ids."""
+    global _uniq_prefix, _uniq_count
+    _uniq_prefix = os.urandom(8)
+    _uniq_count = itertools.count(1)
+
+
+os.register_at_fork(after_in_child=_reseed_after_fork)
+
+
 def fast_unique_bytes() -> bytes:
     return _uniq_prefix + _pack_q(next(_uniq_count))
 
 
 class BaseID:
     __slots__ = ("_bytes",)
+    _type_salt = 0
 
     def __init__(self, id_bytes: bytes):
         if not isinstance(id_bytes, bytes) or len(id_bytes) != ID_LENGTH:
@@ -60,8 +72,14 @@ class BaseID:
     def hex(self) -> str:
         return binascii.hexlify(self._bytes).decode()
 
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        cls._type_salt = hash(cls.__name__)
+
     def __hash__(self):
-        return hash((type(self).__name__, self._bytes))
+        # No tuple allocation: id hashing shows up in every set/dict of
+        # refs on the hot path.
+        return hash(self._bytes) ^ self._type_salt
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

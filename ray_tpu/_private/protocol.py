@@ -25,16 +25,27 @@ message otherwise pays its own pickle + write + reader wakeup
 from __future__ import annotations
 
 import itertools
+import pickle
 import threading
 from concurrent.futures import Future
 from multiprocessing.connection import Connection
 from typing import Any, Callable, Dict, List, Optional
+
+from . import fastpath as _fastpath
 
 # Tuple-frame opcodes.
 OP_CALL = 1  # (1, req_id, task_id, function_id, method, args_blob, num_returns, actor_id)
 OP_REPLY = 2  # (2, req_id, error_blob, results); results = [(inline, segment, size, children)]
 
 _LAZY_MAX = 128  # flush the out-buffer at this depth regardless
+
+# Native frame codec (native/fastpath.c): the hot tuple frames ride a
+# typed binary wire format encoded in C; everything else (and every
+# frame when no toolchain is present) stays pickle. The two are
+# distinguished by the payload's first byte — pickle proto 2+ starts
+# 0x80, fast frames 0xF1 — so mixed senders interoperate per message.
+_fp = _fastpath.get()
+_FAST_MAGIC = 0xF1
 
 
 class ConnectionLost(Exception):
@@ -111,11 +122,14 @@ class PeerConn:
         if not out:
             return
         self._out = []
+        msg = out[0] if len(out) == 1 else ("B", out)
         try:
-            if len(out) == 1:
-                self._conn.send(out[0])
-            else:
-                self._conn.send(("B", out))
+            if _fp is not None:
+                payload = _fp.encode(msg)
+                if payload is not None:
+                    self._conn.send_bytes(payload)
+                    return
+            self._conn.send(msg)
         except (OSError, EOFError, BrokenPipeError, ValueError) as e:
             raise ConnectionLost(str(e)) from e
 
@@ -196,10 +210,16 @@ class PeerConn:
             self._push_handler(msg)
 
     def _read_loop(self) -> None:
-        recv = self._conn.recv
+        recv_bytes = self._conn.recv_bytes
+        loads = pickle.loads
+        decode = _fp.decode if _fp is not None else None
         try:
             while True:
-                msg = recv()
+                buf = recv_bytes()
+                if buf and buf[0] == _FAST_MAGIC and decode is not None:
+                    msg = decode(buf)
+                else:
+                    msg = loads(buf)
                 self._deliver(msg)
                 # Replies generated inline while draining (worker-side
                 # execution on this thread) ship the moment the input
@@ -236,6 +256,23 @@ class PeerConn:
         return self._closed.is_set()
 
     def close(self) -> None:
+        # shutdown(2) first: close() alone does not tear down the
+        # socket while this conn's own reader thread sits blocked in
+        # read() on the fd (the kernel holds the struct file), so the
+        # remote end would never see EOF and blocked peers would hang.
+        # A dup'd wrapper shares the underlying socket, so SHUT_RDWR
+        # lands on it; the wrapper close only drops the dup.
+        try:
+            import os as _os
+            import socket as _socket
+
+            s = _socket.socket(fileno=_os.dup(self._conn.fileno()))
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            finally:
+                s.close()
+        except Exception:  # noqa: BLE001 - non-socket fd or already closed
+            pass
         try:
             self._conn.close()
         except Exception:

@@ -258,6 +258,79 @@ def bench_objects():
     timeit("single_client_wait_1k_refs", wait_1k, min_time=3.0)
 
 
+def bench_scale():
+    """Scale-envelope numbers (reference: release/benchmarks/README.md —
+    many_tasks 588/s end-to-end over 2,000 nodes, many_actors 604/s over
+    250 nodes; this harness runs the single-host equivalents and records
+    absolute rates — there is no like-for-like baseline row)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    # many_queued_tasks: 50k tasks against the head's queue + dispatch.
+    @ray_tpu.remote(num_cpus=1)
+    def unit(i):
+        return i
+
+    n = 50_000
+    t0 = time.perf_counter()
+    refs = [unit.remote(i) for i in range(n)]
+    ray_tpu.get(refs, timeout=900)
+    rate = n / (time.perf_counter() - t0)
+    RESULTS["scale_50k_queued_tasks_per_s"] = round(rate, 1)
+    print(f"scale_50k_queued_tasks_per_s: {rate:,.0f} /s")
+
+    # many_actors: creation + first-call rate (fork-server spawn path).
+    @ray_tpu.remote(num_cpus=0.01)
+    class Cell:
+        def ping(self):
+            return 1
+
+    n_actors = 100
+    t0 = time.perf_counter()
+    actors = [Cell.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+    rate = n_actors / (time.perf_counter() - t0)
+    RESULTS["scale_actor_creation_per_s"] = round(rate, 1)
+    print(f"scale_actor_creation_per_s: {rate:,.1f} /s")
+
+    # call storm across the fleet (n:n at fleet width).
+    t0 = time.perf_counter()
+    refs = [a.ping.remote() for _ in range(20) for a in actors]
+    ray_tpu.get(refs, timeout=600)
+    rate = len(refs) / (time.perf_counter() - t0)
+    RESULTS["scale_actor_call_storm_per_s"] = round(rate, 1)
+    print(f"scale_actor_call_storm_per_s: {rate:,.0f} /s")
+    for a in actors:
+        ray_tpu.kill(a)
+
+    # many_nodes: virtual-node registration + wide PG churn.
+    cluster = Cluster(initialize_head=False)
+    t0 = time.perf_counter()
+    for i in range(200):
+        cluster.add_node(num_cpus=2, label=f"bench{i}")
+    rate = 200 / (time.perf_counter() - t0)
+    RESULTS["scale_node_registrations_per_s"] = round(rate, 1)
+    print(f"scale_node_registrations_per_s: {rate:,.0f} /s")
+
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    t0 = time.perf_counter()
+    n_pgs = 100
+    pgs = [
+        placement_group([{"CPU": 1}] * 4, strategy="SPREAD")
+        for _ in range(n_pgs)
+    ]
+    for pg in pgs:
+        pg.wait(timeout_seconds=60)
+    for pg in pgs:
+        remove_placement_group(pg)
+    rate = n_pgs / (time.perf_counter() - t0)
+    RESULTS["scale_pg_churn_200_nodes_per_s"] = round(rate, 1)
+    print(f"scale_pg_churn_200_nodes_per_s: {rate:,.0f} /s")
+
+
 def bench_placement_groups():
     from ray_tpu.util.placement_group import (
         placement_group,
@@ -288,6 +361,7 @@ def main(argv=None) -> int:
         "actors": bench_actor_calls,
         "objects": bench_objects,
         "pgs": bench_placement_groups,
+        "scale": bench_scale,
     }
     selected = (
         [s.strip() for s in args.only.split(",")] if args.only else list(groups)

@@ -56,6 +56,22 @@ class NodeDaemon:
         self._shutdown = threading.Event()
         self._rejoining = False
         self._draining = False
+        # Fork-server spawning (spawn.py): the zygote starts lazily at
+        # the first spawn, inheriting this daemon's env (node ns, pool,
+        # local-raylet lease addr are all set before any worker exists).
+        from .spawn import WorkerSpawner
+
+        pythonpath = (
+            os.getcwd() + os.pathsep + sys.path[0] + os.pathsep
+            + os.environ.get("PYTHONPATH", "")
+        )
+        self._spawner = WorkerSpawner(
+            {
+                "RAY_TPU_SESSION_ADDR": gcs_address,
+                "RAY_TPU_AUTHKEY": authkey.hex(),
+                "PYTHONPATH": pythonpath,
+            }
+        )
 
         # Node-local object pool: our own namespace + pool, inherited by
         # the workers we spawn. Set BEFORE the store/transfer server are
@@ -179,31 +195,17 @@ class NodeDaemon:
 
     def _spawn_worker(self, msg):
         wid = WorkerID(msg["worker_id"])
-        env = dict(os.environ)
-        env["RAY_TPU_SESSION_ADDR"] = self.gcs_address
-        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
-        env["RAY_TPU_WORKER_ID"] = wid.hex()
-        env["RAY_TPU_NODE_NS"] = self.node_ns
-        env["PYTHONUNBUFFERED"] = "1"  # prints reach the log tailer live
-        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env = {
+            "RAY_TPU_WORKER_ID": wid.hex(),
+            "RAY_TPU_NODE_NS": self.node_ns,
+            "PYTHONUNBUFFERED": "1",  # prints reach the log tailer live
+            "RAY_TPU_NODE_ID": self.node_id.hex(),
+        }
         if msg.get("local_only"):
             env["RAY_TPU_LOCAL_ONLY"] = "1"
-        if not msg.get("tpu"):
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-            env["JAX_PLATFORMS"] = "cpu"
-        env.setdefault("PYTHONPATH", "")
-        env["PYTHONPATH"] = (
-            os.getcwd() + os.pathsep + sys.path[0] + os.pathsep + env["PYTHONPATH"]
-        )
         os.makedirs(self.logs_dir, exist_ok=True)
-        out = open(os.path.join(self.logs_dir, f"worker-{wid.hex()[:8]}.out"), "ab")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
-            env=env,
-            stdout=out,
-            stderr=subprocess.STDOUT,
-        )
-        out.close()
+        log_path = os.path.join(self.logs_dir, f"worker-{wid.hex()[:8]}.out")
+        proc = self._spawner.spawn(env, log_path, tpu=bool(msg.get("tpu")))
         with self._lock:
             self._workers[wid.binary()] = proc
 
@@ -444,6 +446,7 @@ class NodeDaemon:
                 proc.wait(timeout=max(0.0, deadline - time.time()))
             except subprocess.TimeoutExpired:
                 proc.kill()
+        self._spawner.shutdown()
         self.transfer.shutdown()
         try:
             self.conn.close()

@@ -49,6 +49,7 @@ class RefTracker:
         self._dirty: Set[bytes] = set()
         self._lock = threading.Lock()
         self._flusher: Optional[threading.Thread] = None
+        self._wake = threading.Event()
         self._stopped = False
         # oids whose local count hit zero; the client drops lineage for
         # them at flush time.
@@ -66,6 +67,8 @@ class RefTracker:
             n = self._counts.get(oid, 0) + 1
             self._counts[oid] = n
             if n == 1:
+                if not self._dirty:
+                    self._wake.set()
                 self._dirty.add(oid)
                 self._zeroed.discard(oid)
                 self._ensure_flusher()
@@ -75,6 +78,8 @@ class RefTracker:
             n = self._counts.get(oid, 0) - 1
             if n <= 0:
                 self._counts.pop(oid, None)
+                if not self._dirty:
+                    self._wake.set()
                 self._dirty.add(oid)
                 self._zeroed.add(oid)
             else:
@@ -101,8 +106,16 @@ class RefTracker:
     def _flush_loop(self):
         import time
 
+        # Park while clean: an idle process's tracker must cost zero
+        # wakeups (per-process polling timers were the many-actor scale
+        # bottleneck). incr/decr arm the event on the empty->dirty edge;
+        # the interval sleep then batches the burst.
         while not self._stopped:
+            self._wake.wait()
+            if self._stopped:
+                return
             time.sleep(FLUSH_INTERVAL_S)
+            self._wake.clear()
             client = self._client()
             if client is None or client.conn.closed:
                 return
@@ -124,8 +137,10 @@ class RefTracker:
             self._advertised.update(add)
             self._advertised.difference_update(remove)
             zeroed, self._zeroed = self._zeroed, set()
-        for oid in zeroed:
-            client._lineage.pop(oid, None)
+        if zeroed:
+            for oid in zeroed:
+                client._lineage.pop(oid, None)
+            client._wait_prune(zeroed)
         if not add and not remove:
             return
         from .protocol import ConnectionLost

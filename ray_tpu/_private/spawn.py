@@ -1,0 +1,151 @@
+"""Worker process spawning: fork-server fast path + Popen fallback.
+
+Reference: worker_pool.cc StartWorkerProcess — the pool owns process
+creation so callers (scheduler, raylet) just ask for a worker. Here
+`WorkerSpawner.spawn()` forks a warm child off the node's zygote
+(zygote.py, ~5 ms) and falls back to a cold `python -m worker_main`
+subprocess if the zygote is unavailable. TPU workers always take the
+cold path: accelerator plugins read env at interpreter startup, so
+they need a fresh interpreter with the TPU env intact.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+
+class ForkedProc:
+    """Popen-shaped handle for a process forked by the zygote (which is
+    its parent — we cannot waitpid it, only signal/poll by pid)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self._returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._returncode is not None:
+            return self._returncode
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except ProcessLookupError:
+            self._returncode = 0  # exit status unknowable: not our child
+            return self._returncode
+        except PermissionError:
+            return None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return self._returncode or 0
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
+class WorkerSpawner:
+    """One per control-plane process (GCS head / raylet)."""
+
+    def __init__(self, base_env: Dict[str, str]):
+        self._base_env = dict(base_env)
+        self._lock = threading.Lock()
+        self._zygote: Optional[subprocess.Popen] = None
+
+    def _ensure_zygote(self) -> Optional[subprocess.Popen]:
+        z = self._zygote
+        if z is not None and z.poll() is None:
+            return z
+        env = dict(os.environ)
+        env.update(self._base_env)
+        # The zygote's interpreter is CPU-pinned (it imports the core
+        # once); TPU workers never fork from it.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONUNBUFFERED"] = "1"
+        try:
+            self._zygote = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.zygote"],
+                env=env,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+            )
+        except Exception:  # noqa: BLE001
+            self._zygote = None
+        return self._zygote
+
+    def spawn(self, env: Dict[str, str], log_path: str, tpu: bool = False):
+        """Returns a Popen-shaped handle (ForkedProc or Popen)."""
+        if not tpu:
+            with self._lock:
+                z = self._ensure_zygote()
+                if z is not None:
+                    try:
+                        req = {"env": env, "log": log_path}
+                        z.stdin.write((json.dumps(req) + "\n").encode())
+                        z.stdin.flush()
+                        line = z.stdout.readline()
+                        reply = json.loads(line) if line else {}
+                        pid = reply.get("pid")
+                        if pid:
+                            return ForkedProc(pid)
+                    except Exception:  # noqa: BLE001 - zygote died: cold path
+                        try:
+                            z.kill()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        self._zygote = None
+        full_env = dict(os.environ)
+        full_env.update(self._base_env)
+        full_env.update(env)
+        for k, v in list(full_env.items()):
+            if v == "":
+                full_env.pop(k, None)
+        if not tpu:
+            full_env.pop("PALLAS_AXON_POOL_IPS", None)
+            full_env["JAX_PLATFORMS"] = "cpu"
+        out = open(log_path, "ab")
+        try:
+            return subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main"],
+                env=full_env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+            )
+        finally:
+            out.close()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            z, self._zygote = self._zygote, None
+        if z is not None:
+            try:
+                z.stdin.close()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                z.terminate()
+                z.wait(timeout=2)
+            except Exception:  # noqa: BLE001
+                try:
+                    z.kill()
+                except Exception:  # noqa: BLE001
+                    pass

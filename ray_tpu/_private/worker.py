@@ -39,6 +39,20 @@ _global = _GlobalState()
 
 def global_client() -> CoreClient:
     if _global.client is None:
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            # A background thread finding no session is a component that
+            # outlived shutdown() — auto-initing here would silently
+            # spawn a fresh cluster (observed: a serve handle's metrics
+            # thread re-initing after the driver shut down). Only the
+            # main thread auto-inits like the reference does.
+            from ..exceptions import RayTpuError
+
+            raise RayTpuError(
+                "ray_tpu API used from a background thread with no "
+                "initialized session; call ray_tpu.init() first"
+            )
         # Auto-init like the reference does on first API use.
         init()
     return _global.client
@@ -219,7 +233,10 @@ def wait(
     if isinstance(refs, ObjectRef):
         raise TypeError("wait() expects a list of ObjectRefs")
     refs = list(refs)
-    if len(set(refs)) != len(refs):
+    # Uniqueness on raw id bytes: hashing 28-byte keys at C speed, not
+    # ObjectRef.__hash__ chains (this runs per call in drain-by-wait
+    # loops, so the constant matters).
+    if len({r._id._bytes for r in refs}) != len(refs):
         raise ValueError("wait() requires unique ObjectRefs")
     if num_returns > len(refs):
         raise ValueError("num_returns exceeds number of refs")

@@ -59,7 +59,11 @@ class RemoteFunction:
         ) and (opts.get("placement_group_bundle_index") in (None, -1))
         self._resources = _submit.resources_from_options(opts)
         self._num_returns = opts.get("num_returns", 1) or 1
-        self._max_retries = opts.get("max_retries", 0) or 0
+        # Reference default: tasks retry 3x on SYSTEM failure (worker
+        # crash / node loss), never on application exceptions unless
+        # retry_exceptions is set (ray_constants.DEFAULT_TASK_MAX_RETRIES).
+        mr = opts.get("max_retries")
+        self._max_retries = 3 if mr is None else mr
         self._retry_exceptions = bool(opts.get("retry_exceptions", False))
         functools.update_wrapper(self, fn)
 
@@ -161,7 +165,11 @@ class RemoteFunction:
             dependencies=deps,
             num_returns=num_returns,
             resources=_submit.resources_from_options(opts),
-            max_retries=opts.get("max_retries", 0) or 0,
+            max_retries=(
+                3
+                if opts.get("max_retries") is None
+                else opts["max_retries"]
+            ),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             placement_group_id=pg_id,
             placement_group_bundle_index=(

@@ -253,7 +253,14 @@ class Router:
                 rs.inflight[rid] -= 1
 
     def _push_metrics_loop(self):
+        from ..._private.worker import is_initialized
+
         while True:
+            # This daemon thread can outlive serve.shutdown() (handles
+            # are plain objects, nothing joins it): pushing through a
+            # dead session would auto-init a fresh one — exit instead.
+            if not is_initialized():
+                return
             try:
                 self._controller.record_handle_metrics.remote(
                     str(self._dep_id), self._handle_id, self._num_queued, time.time()
