@@ -99,26 +99,59 @@ def main() -> int:
 
     extra = {}
     if os.environ.get("BENCH_LONGCTX", "1") != "0":
-        # Long-context entry: same model, 4x the sequence at batch 1 —
-        # the regime the pallas flash fwd+bwd kernels exist for (the
-        # score matrix at s8192 would be 256 MiB/head/layer in f32 if
-        # materialized; blockwise fwd+bwd never leaves VMEM).
-        lc_seq = int(os.environ.get("BENCH_LONGCTX_SEQ", "8192"))
-        lc_tok, lc_mfu, lc_loss = bench_model(
-            LlamaForCausalLM(cfg), cfg, cfg.num_params(), 1, lc_seq,
-            max(5, steps // 2), peak_flops,
-        )
-        extra.update(
-            longctx_seq=lc_seq,
-            longctx_tokens_per_s=round(lc_tok, 1),
-            longctx_mfu=round(lc_mfu, 3),
-            longctx_loss=round(lc_loss, 3),
-        )
+        # Long-context sweep: same model at batch 1, 4x/8x/16x the
+        # sequence — the regime the pallas flash fwd+bwd kernels exist
+        # for (the score matrix at s8192 would be 256 MiB/head/layer in
+        # f32 if materialized; blockwise fwd+bwd never leaves VMEM).
+        # Points that exceed chip HBM record "oom" instead of failing
+        # the whole bench.
+        lc_seqs = [
+            int(s)
+            for s in os.environ.get(
+                "BENCH_LONGCTX_SEQS", "8192,16384,32768"
+            ).split(",")
+        ]
+        points = []
+        for lc_seq in lc_seqs:
+            try:
+                lc_tok, lc_mfu, lc_loss = bench_model(
+                    LlamaForCausalLM(cfg), cfg, cfg.num_params(), 1, lc_seq,
+                    max(5, steps // 2), peak_flops,
+                )
+            except Exception as exc:  # RESOURCE_EXHAUSTED at the top end
+                if not points:
+                    raise  # first point failing is a bug, not an OOM
+                points.append({"seq": lc_seq, "oom": type(exc).__name__})
+                break
+            points.append(
+                {
+                    "seq": lc_seq,
+                    "tokens_per_s": round(lc_tok, 1),
+                    "mfu": round(lc_mfu, 3),
+                    "loss": round(lc_loss, 3),
+                }
+            )
+        extra["longctx"] = points
+        # Headline long-context fields stay on the first (8k) point for
+        # round-over-round comparability.
+        if points and "mfu" in points[0]:
+            extra.update(
+                longctx_seq=points[0]["seq"],
+                longctx_tokens_per_s=points[0]["tokens_per_s"],
+                longctx_mfu=points[0]["mfu"],
+                longctx_loss=points[0]["loss"],
+            )
     if run_moe:
         from ray_tpu.models.mixtral import CONFIGS as MOE_CONFIGS
         from ray_tpu.models.mixtral import MixtralForCausalLM
 
         moe_cfg = replace(MOE_CONFIGS["mixtral-small"], param_dtype=jnp.bfloat16)
+        # Measured backend selection (capacity vs pallas gmm) on the
+        # live chip, cached per machine; the probe IS the heuristic.
+        from ray_tpu.models.mixtral import resolve_moe_dispatch
+
+        moe_dispatch = resolve_moe_dispatch(moe_cfg, tokens=batch * seq)
+        moe_cfg = replace(moe_cfg, moe_dispatch=moe_dispatch)
         # MFU over *active* FLOPs: a top-k sparse model only computes k of
         # E experts per token.
         moe_tok, moe_mfu, moe_loss = bench_model(
@@ -132,6 +165,7 @@ def main() -> int:
         )
         extra.update(
             moe_model="mixtral-small (8 experts, top-2)",
+            moe_dispatch=moe_dispatch,
             moe_tokens_per_s=round(moe_tok, 1),
             moe_mfu_active=round(moe_mfu, 3),
             moe_loss=round(moe_loss, 3),

@@ -24,6 +24,7 @@ import inspect as _inspect
 
 from ._private.worker import (  # noqa: F401
     available_resources,
+    client_server_address,
     cluster_resources,
     drain_node,
     free,

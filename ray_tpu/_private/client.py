@@ -823,7 +823,7 @@ class CoreClient:
         return fields
 
     def _materialize(self, reply: Dict[str, Any], oid: ObjectID,
-                     _retried: bool = False) -> Any:
+                     _retried: bool = False, packed: bool = False) -> Any:
         from ..exceptions import ObjectLostError
 
         if reply.get("status") == "FAILED":
@@ -834,6 +834,8 @@ class CoreClient:
         if reply.get("status") == "LOST":
             raise ObjectLostError(f"object {oid.hex()} lost (node died)")
         if reply.get("inline") is not None:
+            if packed:
+                return bytes(reply["inline"])
             return serialization.unpack(reply["inline"])
         spilled = reply.get("spilled_path")
         if spilled is not None and not self.store.contains(oid):
@@ -844,7 +846,8 @@ class CoreClient:
             # spill dir).
             try:
                 with open(spilled, "rb") as f:
-                    return serialization.unpack(f.read())
+                    data = f.read()
+                return data if packed else serialization.unpack(data)
             except OSError:
                 pass
         # Cross-node: the object's primary copy lives on another node —
@@ -863,6 +866,15 @@ class CoreClient:
                     f"{owner_node.hex()[:8]} could not be fetched"
                 )
         try:
+            if packed:
+                view = self.store.get_raw(oid)
+                if view is None:
+                    raise FileNotFoundError(oid.hex())
+                try:
+                    return bytes(view)
+                finally:
+                    del view
+                    self.store.release_raw(oid)
             return self.store.get(oid)
         except FileNotFoundError:
             if not _retried:
@@ -872,14 +884,16 @@ class CoreClient:
                 fresh = self.conn.request(
                     {"type": "get_object", "object_id": oid.binary()}
                 )
-                return self._materialize(fresh, oid, _retried=True)
+                return self._materialize(fresh, oid, _retried=True,
+                                         packed=packed)
             # Directory says READY but the data is gone (evicted).
             raise ObjectLostError(
                 f"object {oid.hex()} missing from the local store (evicted)"
             ) from None
 
     def _materialize_or_reconstruct(
-        self, reply: Dict[str, Any], ref: ObjectRef, remaining: Optional[float]
+        self, reply: Dict[str, Any], ref: ObjectRef, remaining: Optional[float],
+        packed: bool = False,
     ) -> Any:
         """Materialize; on loss, resubmit the producing task from lineage
         and retry (reference: ObjectRecoveryManager
@@ -890,7 +904,7 @@ class CoreClient:
         oid = ref.id()
         for _ in range(3):
             try:
-                return self._materialize(reply, oid)
+                return self._materialize(reply, oid, packed=packed)
             except ObjectLostError:
                 spec = self._lineage.get(oid.binary())
                 if spec is None:
@@ -900,7 +914,7 @@ class CoreClient:
                     {"type": "get_object", "object_id": oid.binary()},
                     timeout=remaining,
                 )
-        return self._materialize(reply, oid)
+        return self._materialize(reply, oid, packed=packed)
 
     def _resolve_direct_entry(
         self, ref: ObjectRef, entry, remaining: Optional[float]
@@ -949,7 +963,8 @@ class CoreClient:
             raise exc
         return entry
 
-    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None,
+            packed: bool = False) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         self.flush_lazy()
         # Pipeline: fire every get_object request up front, then collect —
@@ -1001,7 +1016,11 @@ class CoreClient:
                     {"type": "get_object", "object_id": ref.id().binary()},
                     timeout=remaining,
                 )
-            out.append(self._materialize_or_reconstruct(fields, ref, remaining))
+            out.append(
+                self._materialize_or_reconstruct(
+                    fields, ref, remaining, packed=packed
+                )
+            )
         return out
 
     def wait(

@@ -99,6 +99,26 @@ class ObjectStore:
             self._segments[name] = shm
         return name
 
+    def put_packed(self, object_id: ObjectID, blob) -> str:
+        """Write already-flat serialized bytes (the wire/store format)
+        verbatim; returns the location name. Lets a proxy store a
+        remote driver's value without deserializing it."""
+        size = max(len(blob), 1)
+        if self._pool is not None:
+            view = self._pool.create(object_id.binary(), size)
+            if view is not None:
+                view[: len(blob)] = blob
+                del view
+                self._pool.seal(object_id.binary())
+                return "pool"
+        name = segment_name(object_id)
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        _untrack(shm)
+        shm.buf[: len(blob)] = blob
+        with self._lock:
+            self._segments[name] = shm
+        return name
+
     def get(self, object_id: ObjectID) -> Any:
         """Map and deserialize a sealed object (zero-copy buffers)."""
         if self._pool is not None:

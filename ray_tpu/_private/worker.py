@@ -72,6 +72,7 @@ def init(
     ignore_reinit_error: bool = False,
     _temp_dir: Optional[str] = None,
     tcp_port: Optional[int] = None,
+    client_server_port: Optional[int] = None,
 ):
     """Start a local cluster (head) or connect to an existing one.
 
@@ -108,12 +109,33 @@ def init(
                     f"({session_file} missing or stale); run "
                     "`ray-tpu start --head`"
                 ) from None
+        if address is not None and address.startswith("ray_tpu://"):
+            # Thin remote driver (reference: ray://, util/client/worker.py):
+            # one TCP connection to a head-side session process that owns
+            # everything this driver creates and cleans up on disconnect.
+            from .client_proxy import ProxyClient, parse_proxy_address
+
+            hostport, pkey = parse_proxy_address(address)
+            _global.client = ProxyClient(
+                hostport, pkey, push_handler=_driver_push
+            )
+            _global.mode = DRIVER_MODE
+            if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+                try:
+                    _global.client.request(
+                        {"type": "subscribe_logs"}, timeout=5
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+            atexit.register(_atexit_shutdown)
+            return _global.client
         transfer_addr = None
         if address is None:
             node = Node(
                 default_resources(num_cpus, num_tpus, resources),
                 temp_dir=_temp_dir,
                 tcp_port=tcp_port,
+                client_server_port=client_server_port,
             )
             _global.node = node
             address_, authkey = node.address, node.authkey
@@ -269,6 +291,14 @@ def get_actor(name: str):
     if not reply.get("ok"):
         raise ValueError(f"Failed to look up actor '{name}'")
     return ActorHandle(ActorID(reply["actor_id"]))
+
+
+def client_server_address() -> Optional[str]:
+    """The ``ray_tpu://`` address remote drivers can connect to, when
+    this head was started with ``client_server_port`` (reference: the
+    ray:// address printed by `ray start --head`)."""
+    node = _global.node
+    return None if node is None else node.client_server_address
 
 
 def cluster_resources() -> Dict[str, float]:

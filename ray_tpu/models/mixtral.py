@@ -34,15 +34,18 @@ class MixtralConfig(LlamaConfig):
     # (capacity dispatch only).
     capacity_factor: float = 1.25
     router_aux_loss_coef: float = 0.02
-    # "capacity" (default): capacity-bounded static buffers with an
-    # [E, B, C, D] expert axis — mesh-shards for expert parallelism
-    # (dispatch rides an all-to-all over ICI) and lowers to plain
-    # batched matmuls that fill the MXU. "ragged": exact-group sorted
-    # dispatch through lax.ragged_dot — zero capacity padding or drops;
-    # measured SLOWER than capacity on current TPU backends (ragged_dot
-    # lowers to a masked loop), so it stays an option for backends
-    # where it wins and as the semantic oracle for the capacity path.
-    moe_dispatch: str = "capacity"
+    # "auto" (default): measured selection between the backends below,
+    # cached per (backend, device kind, shape) — resolve_moe_dispatch().
+    # "capacity": capacity-bounded static buffers with an [E, B, C, D]
+    # expert axis — mesh-shards for expert parallelism (dispatch rides
+    # an all-to-all over ICI) and lowers to plain batched matmuls, at
+    # the cost of capacity_factor padding FLOPs (25% at 1.25).
+    # "gmm": tile-aligned group-sorted dispatch through the pallas
+    # grouped matmul (ops/gmm.py) — <=E*block_m rows of padding (~6%)
+    # and zero drops; single-device per expert shard (the EP path
+    # stays capacity). "ragged": exact-group lax.ragged_dot — the
+    # semantic oracle; measured slower than both on current backends.
+    moe_dispatch: str = "auto"
 
     def num_params(self) -> int:
         """Llama count minus its dense MLP, plus E stacked experts and
@@ -60,6 +63,127 @@ class MixtralConfig(LlamaConfig):
         dense_mlp = 3 * h * i
         active_mlp = self.num_experts_per_tok * 3 * h * i + h * self.num_experts
         return super().num_params() + l * (active_mlp - dense_mlp)
+
+
+# moe_dispatch="auto" resolutions, keyed by _shape_key: warmed by
+# resolve_moe_dispatch() (outside jit), read at trace time.
+_RESOLVED: dict = {}
+
+
+def _shape_key(cfg: "MixtralConfig") -> str:
+    return (
+        f"E{cfg.num_experts}-K{cfg.num_experts_per_tok}-"
+        f"D{cfg.hidden_size}-F{cfg.intermediate_size}"
+    )
+
+
+def resolve_moe_dispatch(
+    cfg: "MixtralConfig",
+    tokens: int = 4096,
+    mesh=None,
+    steps: int = 10,
+) -> str:
+    """Measure-and-pick the MoE dispatch backend for this device.
+
+    The judge of record is a timed probe of the dispatch+FFN core
+    (fwd+bwd) at this config's shapes on the live backend — not a
+    config flag: ragged_dot vs capacity vs the pallas gmm rank
+    differently across TPU generations and compiler versions.
+    Resolutions persist to ~/.cache/ray_tpu/moe_dispatch.json keyed by
+    (backend, device kind, shape), so the probe runs once per machine.
+    Under an expert-sharded mesh the capacity path is returned without
+    probing (its [E, B, C, D] layout is what rides the EP all-to-all;
+    the gmm layout is per-shard).
+    """
+    import json
+    import os
+    import time
+
+    if cfg.moe_dispatch != "auto":
+        return cfg.moe_dispatch
+    env = os.environ.get("RAY_TPU_MOE_DISPATCH")
+    if env:
+        _RESOLVED[_shape_key(cfg)] = env
+        return env
+    if mesh is not None and mesh.shape.get("expert", 1) > 1:
+        _RESOLVED[_shape_key(cfg)] = "capacity"
+        return "capacity"
+    skey = _shape_key(cfg)
+    if skey in _RESOLVED:
+        return _RESOLVED[skey]
+    dev = jax.devices()[0]
+    cache_key = (
+        f"{jax.default_backend()}-{dev.device_kind}-{skey}-N{tokens}"
+    )
+    cache_path = os.path.join(
+        os.path.expanduser("~"), ".cache", "ray_tpu", "moe_dispatch.json"
+    )
+    try:
+        with open(cache_path) as f:
+            disk = json.load(f)
+    except (OSError, ValueError):
+        disk = {}
+    if cache_key in disk:
+        _RESOLVED[skey] = disk[cache_key]
+        return disk[cache_key]
+
+    import numpy as np
+    from dataclasses import replace as _replace
+
+    probe_cfg = _replace(
+        cfg,
+        vocab_size=256,
+        num_layers=1,
+        num_heads=4,
+        num_kv_heads=4,
+        remat=False,
+    )
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(
+        rng.randn(1, tokens, cfg.hidden_size), probe_cfg.dtype
+    )
+
+    def _time_backend(name: str) -> float:
+        layer = MoELayer(_replace(probe_cfg, moe_dispatch=name))
+        params = jax.jit(layer.init)(jax.random.PRNGKey(0), x[:, :256])
+
+        @jax.jit
+        def step(p, x):
+            def loss(p):
+                return (layer.apply(p, x) ** 2).sum()
+
+            return jax.grad(loss)(p)
+
+        g = step(params, x)
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), g)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            g = step(params, x)
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), g)
+        return time.perf_counter() - t0
+
+    candidates = ["capacity", "gmm"]
+    times = {}
+    for name in candidates:
+        try:
+            times[name] = _time_backend(name)
+        except Exception:  # noqa: BLE001 - backend unsupported here
+            continue
+    if not times:
+        # Transient probe failure (e.g. chip busy): fall back WITHOUT
+        # persisting, so the next process probes again.
+        _RESOLVED[skey] = "capacity"
+        return "capacity"
+    winner = min(times, key=times.get)
+    _RESOLVED[skey] = winner
+    disk[cache_key] = winner
+    try:
+        os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+        with open(cache_path, "w") as f:
+            json.dump(disk, f)
+    except OSError:
+        pass
+    return winner
 
 
 CONFIGS = {
@@ -99,10 +223,16 @@ class MoELayer(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        if cfg.moe_dispatch not in ("ragged", "capacity"):
+        dispatch = cfg.moe_dispatch
+        if dispatch == "auto":
+            # Trace-time: use the process cache warmed by
+            # resolve_moe_dispatch() (bench/trainer call it before jit);
+            # capacity is the safe fallback everywhere.
+            dispatch = _RESOLVED.get(_shape_key(cfg), "capacity")
+        if dispatch not in ("ragged", "capacity", "gmm"):
             raise ValueError(
-                f"moe_dispatch must be 'ragged' or 'capacity', got "
-                f"{cfg.moe_dispatch!r}"
+                f"moe_dispatch must be 'auto', 'ragged', 'capacity' or "
+                f"'gmm', got {cfg.moe_dispatch!r}"
             )
         B, T, D = x.shape
         E, K = cfg.num_experts, cfg.num_experts_per_tok
@@ -141,7 +271,52 @@ class MoELayer(nn.Module):
         w_up = pvar("w_up", (E, D, cfg.intermediate_size))
         w_down = pvar("w_down", (E, cfg.intermediate_size, D))
 
-        if cfg.moe_dispatch == "ragged":
+        if dispatch == "gmm":
+            # Tile-aligned group-sorted dispatch through the pallas
+            # grouped matmul: every block_m row-tile belongs to one
+            # expert, so the FFN runs as dense MXU tiles with ~6%
+            # padding instead of capacity's 25% — and zero drops.
+            from ..ops.gmm import aligned_group_layout, gmm
+
+            N = B * T * K
+            x2 = xd.reshape(B * T, D)
+            e_flat = gate_idx.reshape(N)
+            order, dst, tile_group, m_pad = aligned_group_layout(
+                e_flat, E, block_m=128
+            )
+            tok_of_pair = jnp.arange(N, dtype=jnp.int32) // K
+            tok_sorted = tok_of_pair[order]
+            # Row GATHER into the aligned layout (row scatters serialize
+            # on TPU; gathers vectorize — same trick as the capacity
+            # path). inv maps aligned slot -> sorted-pair index, with
+            # padding slots reading a zero row.
+            inv = (
+                jnp.full((m_pad,), N, jnp.int32)
+                .at[dst]
+                .set(jnp.arange(N, dtype=jnp.int32), unique_indices=True)
+            )
+            src_tok = jnp.concatenate(
+                [tok_sorted, jnp.full((1,), B * T, jnp.int32)]
+            )[inv]
+            x_pad = jnp.concatenate(
+                [x2, jnp.zeros((1, D), x2.dtype)], axis=0
+            )
+            lhs = x_pad[src_tok]  # [m_pad, D]
+            h = gmm(lhs, w_gate.astype(cfg.dtype), tile_group)
+            u = gmm(lhs, w_up.astype(cfg.dtype), tile_group)
+            act = nn.silu(h) * u
+            eo = gmm(act, w_down.astype(cfg.dtype), tile_group)
+            gates_sorted = gate_vals.astype(cfg.dtype).reshape(N)[order]
+            pair_out = eo[dst] * gates_sorted[:, None]
+            out2 = (
+                jnp.zeros((B * T, D), cfg.dtype)
+                .at[tok_sorted]
+                .add(pair_out)
+            )
+            out = out2.reshape(B, T, D)
+            return with_logical_constraint(out, ("batch", "seq", "embed"))
+
+        if dispatch == "ragged":
             # Exact-group dispatch: argsort the (token, k) pairs by
             # expert and run each group through its expert with
             # lax.ragged_dot — FLOPs are exactly the active tokens'.

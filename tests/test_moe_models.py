@@ -196,3 +196,81 @@ def test_ragged_and_capacity_dispatch_agree(tiny_moe):
     np.testing.assert_allclose(
         np.asarray(out_r), np.asarray(out_c), rtol=2e-4, atol=2e-4
     )
+
+
+def test_gmm_dispatch_agrees_with_ragged(tiny_moe, monkeypatch):
+    """The pallas grouped-matmul backend (interpret mode on CPU) is the
+    same mathematical function as the exact ragged dispatch — outputs
+    AND gradients."""
+    import dataclasses
+
+    from ray_tpu.models.mixtral import MoELayer
+
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    cfg, _, _, _ = tiny_moe
+    x = jnp.asarray(
+        np.random.RandomState(5).randn(2, 16, cfg.hidden_size), jnp.float32
+    )
+    ragged = MoELayer(dataclasses.replace(cfg, moe_dispatch="ragged"))
+    gmm_l = MoELayer(dataclasses.replace(cfg, moe_dispatch="gmm"))
+    params = ragged.init(jax.random.PRNGKey(4), x)
+    out_r = ragged.apply(params, x)
+    out_g = gmm_l.apply(params, x)
+    np.testing.assert_allclose(
+        np.asarray(out_r), np.asarray(out_g), rtol=2e-4, atol=2e-4
+    )
+
+    def loss(layer):
+        def f(p, x):
+            return (layer.apply(p, x) ** 2).sum()
+
+        return jax.grad(f, argnums=(0, 1))(params, x)
+
+    gp_r, gx_r = loss(ragged)
+    gp_g, gx_g = loss(gmm_l)
+    np.testing.assert_allclose(
+        np.asarray(gx_r), np.asarray(gx_g), rtol=5e-3, atol=5e-3
+    )
+    flat_r = jax.tree_util.tree_leaves(gp_r)
+    flat_g = jax.tree_util.tree_leaves(gp_g)
+    for a, b in zip(flat_r, flat_g):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3
+        )
+
+
+def test_moe_dispatch_auto_resolution(tiny_moe, monkeypatch, tmp_path):
+    """"auto" resolves via a measured probe, caches to disk, and forces
+    capacity under an expert-sharded mesh."""
+    import dataclasses
+
+    from ray_tpu.models import mixtral as mx
+
+    cfg, _, _, _ = tiny_moe
+    auto_cfg = dataclasses.replace(cfg, moe_dispatch="auto")
+
+    # Env override wins without probing.
+    monkeypatch.setenv("RAY_TPU_MOE_DISPATCH", "ragged")
+    mx._RESOLVED.clear()
+    assert mx.resolve_moe_dispatch(auto_cfg) == "ragged"
+    monkeypatch.delenv("RAY_TPU_MOE_DISPATCH")
+
+    # Expert-sharded mesh forces the EP-capable capacity layout.
+    from ray_tpu.parallel import MeshSpec
+
+    mesh = MeshSpec(data=2, expert=4).build()
+    mx._RESOLVED.clear()
+    assert mx.resolve_moe_dispatch(auto_cfg, mesh=mesh) == "capacity"
+
+    # Measured probe on this backend: must return a working backend and
+    # persist it (gmm needs interpret mode to be probe-able on CPU).
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    mx._RESOLVED.clear()
+    winner = mx.resolve_moe_dispatch(auto_cfg, tokens=64, steps=1)
+    assert winner in ("capacity", "gmm")
+    cache = tmp_path / ".cache" / "ray_tpu" / "moe_dispatch.json"
+    assert cache.exists()
+    # Cached: a fresh in-process resolution short-circuits to the same.
+    mx._RESOLVED.clear()
+    assert mx.resolve_moe_dispatch(auto_cfg) == winner
