@@ -204,6 +204,11 @@ class GcsServer:
         self.authkey = authkey
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
+        # An autoscaler announced itself: capacity is elastic, so PGs
+        # exceeding CURRENT totals queue PENDING as autoscaler demand
+        # instead of failing fast (reference:
+        # gcs_placement_group_manager keeps infeasible PGs pending).
+        self.autoscaling_hint = False
 
         self.objects: Dict[bytes, ObjectEntry] = {}
         self.functions: Dict[bytes, bytes] = {}
@@ -1345,7 +1350,7 @@ class GcsServer:
                 # when resources free up (e.g. leased workers return);
                 # only structurally infeasible requests fail fast.
                 total_ok, _ = self._try_reserve_pg(pg, dry_totals=True)
-                if not total_ok:
+                if not total_ok and not self.autoscaling_hint:
                     peer.reply(msg, ok=False, error=err)
                     return
                 pg.state = "PENDING"
@@ -1427,6 +1432,31 @@ class GcsServer:
             self._work.notify_all()
         if "req_id" in msg:
             state["peer"].reply(msg, ok=True)
+
+    def _h_wait_placement_group(self, state, msg):
+        """Park until the PG reserves (or is removed); the client's
+        request timeout bounds the wait — no polling."""
+        with self._lock:
+            pg = self.placement_groups.get(msg["pg_id"])
+            if pg is None:
+                state["peer"].reply(msg, ok=False, error="no such pg")
+                return
+            if pg.state != "PENDING":
+                state["peer"].reply(msg, ok=True, state=pg.state)
+                return
+            pg.waiters.append((state["peer"], msg["req_id"]))
+
+    def _notify_pg_waiters(self, pg) -> None:
+        """Caller holds the lock; answers everyone parked on this PG."""
+        waiters, pg.waiters = pg.waiters, []
+        for peer, req_id in waiters:
+            try:
+                peer.send(
+                    {"type": "reply", "req_id": req_id, "ok": True,
+                     "state": pg.state}
+                )
+            except Exception:  # noqa: BLE001 - waiter gone
+                pass
 
     def _h_placement_group_info(self, state, msg):
         with self._lock:
@@ -1539,12 +1569,21 @@ class GcsServer:
         state["peer"].reply(msg, ok=True, items=items[:limit],
                             total=len(items))
 
+    def _h_set_autoscaling(self, state, msg):
+        with self._lock:
+            self.autoscaling_hint = bool(msg.get("enabled", True))
+        state["peer"].reply(msg, ok=True)
+
     def _h_get_pending_demand(self, state, msg):
         """Resource shapes the scheduler can't currently place — the
         autoscaler's input (reference: autoscaler v2 reads cluster
         resource state from the GCS AutoscalerStateService,
-        autoscaler.proto:315)."""
+        autoscaler.proto:315). Polling this IS the autoscaler
+        announcing itself: capacity becomes elastic, so over-capacity
+        PGs queue as demand (self-healing across head restarts,
+        unlike a one-shot flag)."""
         with self._lock:
+            self.autoscaling_hint = True
             demands = [dict(spec.resources) for spec in self._pending]
             pg_demands = [
                 [dict(b.resources) for b in pg.bundles]
@@ -1606,6 +1645,7 @@ class GcsServer:
             for pg in self.placement_groups.values():
                 if pg.state == "PENDING" and self._try_reserve_pg(pg)[0]:
                     pg.state = "CREATED"
+                    self._notify_pg_waiters(pg)
             self._work.notify_all()
         peer.reply(
             msg,
@@ -2515,6 +2555,7 @@ class GcsServer:
         for pg in self.placement_groups.values():
             if pg.state == "PENDING" and self._try_reserve_pg(pg)[0]:
                 pg.state = "CREATED"
+                self._notify_pg_waiters(pg)
                 self._version += 1
                 progressed = True
         requeue: List[TaskSpec] = []

@@ -1,0 +1,208 @@
+"""TPU-slice cloud provider: atomic multi-host slices for autoscaler v2.
+
+Reference: python/ray/autoscaler/_private/gcp/node_provider.py + the
+GCE TPU queued-resource model. A TPU slice is ATOMIC: all its hosts are
+created together and deleted together — there is no such thing as
+"half a v5e-8". The provider therefore:
+
+- launches a whole slice per instance (one Instance record == one
+  slice of N hosts, each joining the cluster as its own node);
+- rolls the entire slice back if ANY host fails to come up (partial
+  creation must never leak quota — the reference's GCP provider
+  deletes the queued resource on partial failure);
+- terminates whole slices only.
+
+The API surface (``TpuSliceApi``) is the mockable seam: the real
+implementation would call the GCE TPU REST API; ``MockTpuSliceApi``
+runs each "host VM" as a real node-daemon subprocess (the same
+``ray_tpu._private.raylet`` a VM startup script would exec), with
+injectable per-host creation failures — so the reconciler loop is
+tested end-to-end against honest slice semantics on one box.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .v2 import CloudProvider, Instance
+
+
+class PartialSliceError(RuntimeError):
+    """Some hosts of a slice failed to create; the slice is unusable
+    and must be rolled back whole."""
+
+    def __init__(self, name: str, failed_hosts: List[int]):
+        super().__init__(f"slice {name}: hosts {failed_hosts} failed")
+        self.name = name
+        self.failed_hosts = failed_hosts
+
+
+@dataclass
+class SliceType:
+    """Shape of one sliceable node type (e.g. ``TPU-v5e-8``: 2 hosts x
+    4 chips)."""
+
+    accelerator: str  # e.g. "v5e-8"
+    hosts: int
+    host_resources: Dict[str, float]  # per host, e.g. {"CPU": 8, "TPU": 4}
+    max_slices: int = 4
+
+    @property
+    def head_resource(self) -> str:
+        # Worker 0 carries slice leadership (matches the accelerator
+        # layer's synthetic gang resource, accelerators/tpu.py).
+        return f"TPU-{self.accelerator}-head"
+
+    def node_type_config(self) -> Dict[str, Any]:
+        """The autoscaler v2 node_types entry for this slice type."""
+        return {
+            "resources": dict(self.host_resources),
+            "hosts": self.hosts,
+            "head_resource": self.head_resource,
+            "max_workers": self.max_slices,
+        }
+
+
+class TpuSliceApi:
+    """Mockable slice-granular cloud API (the GCE TPU surface shape:
+    create/delete/list of whole slices, never individual hosts)."""
+
+    def create_slice(self, name: str, accelerator: str,
+                     host_commands: List[List[str]]) -> None:
+        """Create all hosts of a slice; raises PartialSliceError if any
+        host fails (leaving the survivors up, as a real partially-
+        fulfilled queued resource would)."""
+        raise NotImplementedError
+
+    def delete_slice(self, name: str) -> None:
+        """Tear down every host of the slice (idempotent)."""
+        raise NotImplementedError
+
+    def list_slices(self) -> Dict[str, Dict[str, Any]]:
+        """name -> {"hosts": n_alive} for slices with any live host."""
+        raise NotImplementedError
+
+
+class MockTpuSliceApi(TpuSliceApi):
+    """Each host "VM" is a real node-daemon subprocess. Failure
+    injection: ``fail_next`` holds per-call lists of host indices that
+    must fail to create (consumed one list per create_slice call)."""
+
+    def __init__(self):
+        self._slices: Dict[str, List[subprocess.Popen]] = {}
+        self.fail_next: List[List[int]] = []
+        self.create_calls = 0
+        self.deleted: List[str] = []
+
+    def create_slice(self, name, accelerator, host_commands):
+        self.create_calls += 1
+        failures = self.fail_next.pop(0) if self.fail_next else []
+        procs: List[subprocess.Popen] = []
+        failed: List[int] = []
+        for i, cmd in enumerate(host_commands):
+            if i in failures:
+                failed.append(i)
+                continue
+            procs.append(
+                subprocess.Popen(
+                    cmd,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+            )
+        self._slices[name] = procs
+        if failed:
+            raise PartialSliceError(name, failed)
+
+    def delete_slice(self, name):
+        for proc in self._slices.pop(name, []):
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        self.deleted.append(name)
+
+    def list_slices(self):
+        return {
+            name: {"hosts": sum(1 for p in procs if p.poll() is None)}
+            for name, procs in self._slices.items()
+            if any(p.poll() is None for p in procs)
+        }
+
+    def shutdown(self):
+        for name in list(self._slices):
+            self.delete_slice(name)
+
+
+class TpuSliceProvider(CloudProvider):
+    """Autoscaler v2 provider with whole-slice atomicity."""
+
+    def __init__(
+        self,
+        api: TpuSliceApi,
+        slice_types: Dict[str, SliceType],
+        head_address: str,
+        authkey: bytes,
+        transfer_host: str = "127.0.0.1",
+    ):
+        self.api = api
+        self.slice_types = slice_types
+        self.head_address = head_address
+        self.authkey = authkey
+        self.transfer_host = transfer_host
+
+    def node_types(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            t: st.node_type_config() for t, st in self.slice_types.items()
+        }
+
+    def _host_command(self, instance: Instance, st: SliceType,
+                      host_index: int) -> List[str]:
+        import json
+
+        resources = dict(st.host_resources)
+        if host_index == 0:
+            resources[st.head_resource] = 1.0
+        return [
+            sys.executable,
+            "-m",
+            "ray_tpu._private.raylet",
+            "--address",
+            self.head_address,
+            "--authkey",
+            self.authkey.hex(),
+            "--resources",
+            json.dumps(resources),
+            "--label",
+            f"v2:{instance.instance_id}:h{host_index}",
+            "--transfer-host",
+            self.transfer_host,
+        ]
+
+    def launch(self, instance: Instance) -> str:
+        st = self.slice_types[instance.node_type]
+        name = f"slice-{instance.instance_id}"
+        cmds = [
+            self._host_command(instance, st, i) for i in range(st.hosts)
+        ]
+        try:
+            self.api.create_slice(name, st.accelerator, cmds)
+        except PartialSliceError:
+            # Atomic rollback: a partially-created slice is deleted
+            # whole; the reconciler retries from QUEUED.
+            self.api.delete_slice(name)
+            raise
+        return name
+
+    def terminate(self, cloud_instance_id: str) -> None:
+        self.api.delete_slice(cloud_instance_id)
+
+    def running_instances(self) -> Dict[str, Any]:
+        out = {}
+        for name, meta in self.api.list_slices().items():
+            out[name] = meta
+        return out

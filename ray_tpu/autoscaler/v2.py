@@ -59,6 +59,7 @@ class Instance:
     instance_id: str
     node_type: str
     resources: Dict[str, float]
+    hosts: int = 1  # >1: an atomic multi-host slice (all-or-nothing)
     status: str = QUEUED
     cloud_instance_id: Optional[str] = None
     node_id: Optional[bytes] = None  # GCS node id once RAY_RUNNING
@@ -74,11 +75,13 @@ class InstanceManager:
     def __init__(self):
         self._instances: Dict[str, Instance] = {}
 
-    def create(self, node_type: str, resources: Dict[str, float]) -> Instance:
+    def create(self, node_type: str, resources: Dict[str, float],
+               hosts: int = 1) -> Instance:
         inst = Instance(
             instance_id=uuid.uuid4().hex[:12],
             node_type=node_type,
             resources=dict(resources),
+            hosts=hosts,
         )
         inst.history.append(QUEUED)
         self._instances[inst.instance_id] = inst
@@ -201,25 +204,20 @@ class Reconciler:
 
         return global_client()
 
-    def _ray_nodes(self) -> Dict[str, Dict]:
-        """instance_id -> GCS node dict, matched by the v2 label."""
-        out = {}
-        for n in self._client().cluster_info()["nodes"]:
-            label = n.get("label", "")
-            if label.startswith("v2:"):
-                out[label[3:]] = n
-        return out
 
     # -------------------------------------------------------------- step
     def step(self) -> None:
         now = time.monotonic()
         cloud = self.provider.running_instances()
         info = self._client().cluster_info()
-        ray_view = {
-            n["label"][3:]: n
-            for n in info["nodes"]
-            if n.get("label", "").startswith("v2:")
-        }
+        # instance_id -> [host nodes]; single-host instances are
+        # labeled "v2:<iid>", slice hosts "v2:<iid>:h<k>".
+        ray_view: Dict[str, List[Dict]] = {}
+        for n in info["nodes"]:
+            label = n.get("label", "")
+            if label.startswith("v2:"):
+                iid = label[3:].split(":", 1)[0]
+                ray_view.setdefault(iid, []).append(n)
         reply = self._client().request({"type": "get_pending_demand"})
         self._sync_cloud(cloud, now)
         self._sync_ray(ray_view, cloud)
@@ -243,17 +241,29 @@ class Reconciler:
         for inst in self.im.instances(ALLOCATED, RAY_RUNNING):
             if inst.cloud_instance_id not in cloud:
                 self.im.transition(inst, RAY_STOPPED)
+        # Allocated but never (fully) joined — e.g. one slice host died
+        # before registering: the survivors pin phantom capacity and
+        # the demand they were launched for can never place. Recycle.
+        for inst in self.im.instances(ALLOCATED):
+            if now - inst.status_since > self.request_timeout_s:
+                self.im.transition(inst, RAY_STOPPED)
 
     # -------------------------------------------------------- ray sync
-    def _sync_ray(self, ray_view: Dict[str, Dict], cloud) -> None:
+    def _sync_ray(self, ray_view: Dict[str, List[Dict]], cloud) -> None:
         for inst in self.im.instances(ALLOCATED):
-            node = ray_view.get(inst.instance_id)
-            if node is not None and node["alive"]:
-                inst.node_id = node["node_id"]
+            alive = [
+                n for n in ray_view.get(inst.instance_id, []) if n["alive"]
+            ]
+            # A slice runs only when EVERY host has joined (atomic).
+            if len(alive) >= inst.hosts:
+                inst.node_id = alive[0]["node_id"]
                 self.im.transition(inst, RAY_RUNNING)
         for inst in self.im.instances(RAY_RUNNING):
-            node = ray_view.get(inst.instance_id)
-            if node is None or not node["alive"]:
+            alive = [
+                n for n in ray_view.get(inst.instance_id, []) if n["alive"]
+            ]
+            # Losing ANY host kills the whole slice.
+            if len(alive) < inst.hosts:
                 self.im.transition(inst, RAY_STOPPED)
         for inst in self.im.instances(RAY_STOPPED):
             self.im.transition(inst, TERMINATING)
@@ -267,7 +277,13 @@ class Reconciler:
         shapes = list(reply["task_demands"])
         for bundle_list in reply["pg_demands"]:
             shapes.extend(bundle_list)
-        return [s for s in shapes if s]
+        # Head/gang resources exist on exactly one host per slice; fit
+        # those shapes first so a plain bundle never squats the head
+        # host and forces a spurious extra slice.
+        return sorted(
+            (s for s in shapes if s),
+            key=lambda s: not any(k.endswith("-head") for k in s),
+        )
 
     def _scale_up(self, reply, nodes: List[Dict[str, Any]]) -> None:
         demands = self._pending_shapes(reply)
@@ -280,10 +296,10 @@ class Reconciler:
         # the same need while a daemon is still registering).
         capacities: List[Dict[str, float]] = [
             dict(n["available"]) for n in nodes if n["alive"]
-        ] + [
-            dict(i.resources)
-            for i in self.im.instances(QUEUED, REQUESTED, ALLOCATED)
         ]
+        for i in self.im.instances(QUEUED, REQUESTED, ALLOCATED):
+            cfg = self.node_types.get(i.node_type, {"resources": i.resources})
+            capacities.extend(self._host_capacities(cfg))
         to_launch: List[str] = []
         counts: Dict[str, int] = {}
         for i in self.im.instances():
@@ -304,19 +320,38 @@ class Reconciler:
                     "max_workers", 10
                 ):
                     continue
-                if _fits(cfg["resources"], shape):
-                    cap = dict(cfg["resources"])
+                host_caps = self._host_capacities(cfg)
+                hit = next(
+                    (c for c in host_caps if _fits(c, shape)), None
+                )
+                if hit is not None:
                     for k, v in shape.items():
-                        cap[k] -= v
-                    capacities.append(cap)
+                        hit[k] -= v
+                    # Remaining bundles of the same gang can land on
+                    # the other hosts of this pending slice.
+                    capacities.extend(host_caps)
                     to_launch.append(t)
                     break
         for t in to_launch:
-            inst = self.im.create(t, self.node_types[t]["resources"])
+            cfg = self.node_types[t]
+            inst = self.im.create(
+                t, cfg["resources"], hosts=cfg.get("hosts", 1)
+            )
             self._launch(inst)
         # Re-launch retried instances.
         for inst in self.im.instances(QUEUED):
             self._launch(inst)
+
+    @staticmethod
+    def _host_capacities(cfg: Dict[str, Any]) -> List[Dict[str, float]]:
+        """Per-host capacity dicts for a node type (slice types have
+        several hosts; host 0 carries the gang head resource)."""
+        hosts = cfg.get("hosts", 1)
+        caps = [dict(cfg["resources"]) for _ in range(hosts)]
+        head = cfg.get("head_resource")
+        if head:
+            caps[0][head] = caps[0].get(head, 0) + 1.0
+        return caps
 
     def _launch(self, inst: Instance) -> None:
         inst.launch_attempts += 1
@@ -330,24 +365,26 @@ class Reconciler:
         self.im.transition(inst, REQUESTED)
 
     # ------------------------------------------------------ scale down
-    def _scale_down(self, reply, ray_view: Dict[str, Dict], now: float) -> None:
+    def _scale_down(self, reply, ray_view: Dict[str, List[Dict]],
+                    now: float) -> None:
         idle_node_ids = set(reply.get("idle_nodes", []))
         for inst in self.im.instances(RAY_RUNNING):
             if inst.instance_id in self._draining:
                 continue
-            node = ray_view.get(inst.instance_id)
-            if node is None:
+            nodes = ray_view.get(inst.instance_id)
+            if not nodes:
                 continue
-            if node["node_id"] in idle_node_ids:
+            if all(n["node_id"] in idle_node_ids for n in nodes):
                 since = self._idle_since.setdefault(inst.instance_id, now)
                 if now - since >= self.idle_timeout_s:
                     from .._private.worker import drain_node
 
-                    drain_node(
-                        node["node_id"],
-                        reason="autoscaler v2 idle scale-down",
-                        deadline_s=self.drain_deadline_s,
-                    )
+                    for n in nodes:
+                        drain_node(
+                            n["node_id"],
+                            reason="autoscaler v2 idle scale-down",
+                            deadline_s=self.drain_deadline_s,
+                        )
                     self._draining.add(inst.instance_id)
                     self._idle_since.pop(inst.instance_id, None)
             else:

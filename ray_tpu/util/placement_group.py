@@ -39,9 +39,19 @@ class PlacementGroup:
         return bool(info.get("ok")) and info.get("state") == "CREATED"
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
-        # Creation is synchronous in this control plane; reservation already
-        # happened (or failed) by the time the PG object exists.
-        return self.ready()
+        """Block until every bundle is reserved. PGs can sit PENDING
+        (capacity busy, or an autoscaler still adding nodes —
+        reference: gcs_placement_group_manager pending queue). The
+        request parks at the GCS and is answered on the state
+        transition — no polling."""
+        try:
+            reply = global_client().request(
+                {"type": "wait_placement_group", "pg_id": self.id.binary()},
+                timeout=timeout_seconds,
+            )
+        except Exception:  # noqa: BLE001 - timeout
+            return False
+        return bool(reply.get("ok")) and reply.get("state") == "CREATED"
 
     def bundle_placements(self) -> List[Optional[bytes]]:
         info = global_client().request(

@@ -1,0 +1,141 @@
+"""TPU-slice autoscaler provider: atomic multi-host slices.
+
+Reference behavior being matched: the GCP provider's whole-slice
+queued-resource semantics (autoscaler/_private/gcp/node_provider.py) —
+create/delete whole slices, gang node types, rollback of partial
+creations.
+"""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.tpu_slice import (
+    MockTpuSliceApi,
+    PartialSliceError,
+    SliceType,
+    TpuSliceProvider,
+)
+from ray_tpu.autoscaler.v2 import (
+    ALLOCATION_FAILED,
+    RAY_RUNNING,
+    TERMINATED,
+    Instance,
+    Reconciler,
+)
+
+V5E8 = SliceType(
+    accelerator="v5e-8",
+    hosts=2,
+    host_resources={"CPU": 2.0, "TPU": 4.0},
+    max_slices=2,
+)
+
+
+@pytest.fixture()
+def head():
+    ray_tpu.init(num_cpus=1, tcp_port=0, ignore_reinit_error=True)
+    from ray_tpu._private.worker import _global
+
+    api = MockTpuSliceApi()
+    provider = TpuSliceProvider(
+        api,
+        {"tpu-v5e-8": V5E8},
+        _global.node.tcp_address,
+        _global.node.authkey,
+    )
+    try:
+        yield api, provider
+    finally:
+        api.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_partial_creation_rolls_back_whole_slice(head):
+    api, provider = head
+    api.fail_next.append([1])  # host 1 of the first create fails
+    inst = Instance(
+        instance_id="abc123",
+        node_type="tpu-v5e-8",
+        resources=dict(V5E8.host_resources),
+        hosts=2,
+    )
+    with pytest.raises(PartialSliceError):
+        provider.launch(inst)
+    # Atomicity: the surviving host was deleted with the slice — no
+    # leaked quota, nothing reported running.
+    assert "slice-abc123" in api.deleted
+    assert api.list_slices() == {}
+
+
+def test_pg_demand_drives_slice_scale_up_with_retry(head):
+    """A placement group demanding the v5e-8 gang (head resource +
+    per-host TPU bundles) makes the reconciler launch ONE whole slice;
+    a partial creation on the first attempt rolls back and retries."""
+    api, provider = head
+    rec = Reconciler(
+        provider.node_types(),
+        provider,
+        idle_timeout_s=300.0,  # no scale-down during the test
+    )
+    rec.step()  # autoscaler running => GCS queues over-capacity PGs
+    api.fail_next.append([0])  # first slice creation partially fails
+
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group(
+        [
+            {"TPU-v5e-8-head": 1.0, "TPU": 4.0},
+            {"TPU": 4.0},
+        ],
+        strategy="STRICT_SPREAD",
+    )
+    deadline = time.time() + 90
+    while time.time() < deadline and not rec.im.instances(RAY_RUNNING):
+        rec.step()
+        time.sleep(0.3)
+    assert rec.im.instances(RAY_RUNNING), rec.summary()
+    # Retry happened: one failed creation (rolled back), one success —
+    # and only ONE live slice serves both bundles (gang, not 2 slices).
+    assert api.create_calls == 2
+    assert rec.im.instances(ALLOCATION_FAILED) == []
+    assert len(api.list_slices()) == 1
+    assert all(m["hosts"] == 2 for m in api.list_slices().values())
+    # The gang actually becomes placeable: the PG reservation completes.
+    assert pg.wait(timeout_seconds=60), "placement group never became ready"
+    from ray_tpu.util.placement_group import remove_placement_group
+
+    remove_placement_group(pg)
+
+
+def test_host_loss_kills_whole_slice(head):
+    api, provider = head
+    rec = Reconciler(
+        provider.node_types(),
+        provider,
+        idle_timeout_s=300.0,
+    )
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    rec.step()  # autoscaler running => GCS queues over-capacity PGs
+    pg = placement_group([{"TPU": 4.0}, {"TPU": 4.0}], strategy="STRICT_SPREAD")
+    deadline = time.time() + 90
+    while time.time() < deadline and not rec.im.instances(RAY_RUNNING):
+        rec.step()
+        time.sleep(0.3)
+    assert rec.im.instances(RAY_RUNNING), rec.summary()
+    remove_placement_group(pg)
+
+    # Kill ONE host VM: the slice is no longer whole — the reconciler
+    # must terminate the ENTIRE slice (atomic), not limp on one host.
+    (name, procs), = api._slices.items()
+    procs[0].kill()
+    deadline = time.time() + 60
+    while time.time() < deadline and not rec.im.instances(TERMINATED):
+        rec.step()
+        time.sleep(0.3)
+    assert rec.im.instances(TERMINATED), rec.summary()
+    assert api.list_slices() == {}
