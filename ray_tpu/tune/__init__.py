@@ -41,6 +41,7 @@ from .search import (
     sample_from,
     uniform,
 )
+from .tpe import BOHBSearch, Repeater, TPESearch
 from .trainable import Trainable, wrap_function
 from .tune_controller import Trial, TuneController
 from .tuner import ResultGrid, TuneConfig, Tuner, run
@@ -74,6 +75,9 @@ __all__ = [
     "FIFOScheduler",
     "MedianStoppingRule",
     "OptunaSearch",
+    "TPESearch",
+    "BOHBSearch",
+    "Repeater",
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",

@@ -79,9 +79,24 @@ class TuneController:
         self.max_failures = max_failures
         self.experiment_dir = experiment_dir
         os.makedirs(experiment_dir, exist_ok=True)
-        # num_samples only parameterizes the controller-created default
         # searcher; a user-supplied search_alg keeps its own settings.
         self.searcher = search_alg or BasicVariantGenerator(num_samples=num_samples)
+        # Reference semantics (tune/tune.py): with an explicit
+        # model-based searcher, num_samples caps total suggestions
+        # (those searchers never self-exhaust). Queue-based searchers
+        # (total_trials anywhere in the wrapper chain) encode their own
+        # budget and must not be capped by the num_samples default.
+        def _self_exhausting(s):
+            while s is not None:
+                if hasattr(s, "total_trials"):
+                    return True
+                s = getattr(s, "searcher", None)
+            return False
+
+        self._max_trials = (
+            None if search_alg is None or _self_exhausting(search_alg)
+            else num_samples
+        )
         self.searcher.set_search_properties(metric, mode, param_space)
         self.scheduler = scheduler or FIFOScheduler()
         self.scheduler.set_properties(metric, mode)
@@ -108,6 +123,8 @@ class TuneController:
         return None
 
     def _new_trial(self):
+        if self._max_trials is not None and len(self.trials) >= self._max_trials:
+            return None
         trial_id = f"trial_{len(self.trials):04d}_{uuid.uuid4().hex[:6]}"
         cfg = self.searcher.suggest(trial_id)
         if cfg is None or cfg is Searcher.BACKOFF:
