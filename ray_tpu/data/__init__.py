@@ -21,8 +21,11 @@ from .dataset import (
     read_datasource,
     read_json,
     read_numpy,
+    read_images,
     read_parquet,
+    read_sql,
     read_tfrecords,
+    read_webdataset,
 )
 from .datasource import Datasource, ReadTask
 from .iterator import DataIterator
@@ -48,6 +51,9 @@ __all__ = [
     "read_datasource",
     "read_json",
     "read_numpy",
+    "read_images",
     "read_parquet",
+    "read_sql",
+    "read_webdataset",
     "read_tfrecords",
 ]

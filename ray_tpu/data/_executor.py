@@ -19,6 +19,7 @@ import ray_tpu
 from .block import Block, BlockAccessor, BlockMetadata, concat_blocks
 from .datasource import ReadTask
 from ._plan import AllToAll, InputData, MapSegment, MapSpec, Read
+from ._resource_manager import ResourceManager
 
 # A bundle is (block_ref, metadata). Metadata rides the control plane so
 # the driver never fetches payloads it does not need (reference: RefBundle).
@@ -156,7 +157,8 @@ def _zip_task(left: Block, right: Block):
 class StreamingExecutor:
     """Runs the optimized segment list, yielding output bundles in order."""
 
-    def __init__(self, max_in_flight: Optional[int] = None):
+    def __init__(self, max_in_flight: Optional[int] = None,
+                 memory_budget_bytes: Optional[int] = None):
         if max_in_flight is None:
             try:
                 max_in_flight = max(
@@ -165,12 +167,24 @@ class StreamingExecutor:
             except Exception:
                 max_in_flight = 4
         self.max_in_flight = max_in_flight
+        if memory_budget_bytes is None:
+            import os
+
+            env = os.environ.get("RAY_TPU_DATA_MEMORY_BUDGET")
+            memory_budget_bytes = int(env) if env else None
+        self.memory_budget_bytes = memory_budget_bytes
+        # Bound on OUTSTANDING BYTES across operators (reference:
+        # ReservationOpResourceAllocator); assigned per-execute once the
+        # operator count is known.
+        self.resource_manager: Optional[ResourceManager] = None
 
     # --- map segments (streaming) ---
 
     def _run_map_segment(
-        self, seg: MapSegment, upstream: Optional[Iterator[Bundle]]
+        self, seg: MapSegment, upstream: Optional[Iterator[Bundle]],
+        op_id: int = 0,
     ) -> Iterator[Bundle]:
+        rm = self.resource_manager or ResourceManager(None, 1)
         if isinstance(seg.source, InputData):
             inputs: Iterator[Any] = iter(seg.source.bundles)
             mode = "bundle"
@@ -191,13 +205,15 @@ class StreamingExecutor:
                 yield from upstream
                 return
 
-        pending: Dict[Any, Tuple[int, Any]] = {}  # meta_ref -> (idx, block_ref)
+        # meta_ref -> (idx, block_ref, est_bytes)
+        pending: Dict[Any, Tuple[int, Any, float]] = {}
         done: List[Tuple[int, Bundle]] = []  # heap by idx
         next_emit = 0
         next_idx = 0
         rows_emitted = 0
         exhausted = False
         stop = seg.stop_after_rows
+        staged: Optional[Tuple[Any, float]] = None  # pulled, awaiting budget
 
         def trim(bundle: Bundle) -> Bundle:
             """Slice the final bundle so limit(n) is exact, not
@@ -209,12 +225,29 @@ class StreamingExecutor:
             return (b_ref, ray_tpu.get(m_ref))
 
         def launch_one() -> bool:
-            nonlocal next_idx, exhausted
-            try:
-                item = next(inputs)
-            except StopIteration:
-                exhausted = True
+            """Pull (or resume) one input and launch it if the memory
+            budget allows; False = stop trying this round."""
+            nonlocal next_idx, exhausted, staged
+            if staged is not None:
+                item, est = staged
+            else:
+                try:
+                    item = next(inputs)
+                except StopIteration:
+                    exhausted = True
+                    return False
+                hint = (
+                    item.metadata.size_bytes
+                    if mode == "read"
+                    else item[1].size_bytes
+                )
+                est = rm.estimate_output(op_id, float(hint or 0))
+            if not rm.can_launch(op_id, est):
+                # Hold the pulled item; upstream stays paused too (the
+                # pull chain is how backpressure propagates).
+                staged = (item, est)
                 return False
+            staged = None
             if mode == "read":
                 block_ref, meta_ref = _read_map_task.remote(
                     item, seg.spec, next_idx
@@ -222,12 +255,13 @@ class StreamingExecutor:
             else:
                 in_ref = item[0]
                 block_ref, meta_ref = _map_task.remote(in_ref, seg.spec, next_idx)
-            pending[meta_ref] = (next_idx, block_ref)
+            rm.on_launch(op_id, est)
+            pending[meta_ref] = (next_idx, block_ref, est)
             next_idx += 1
             return True
 
         while True:
-            # Backpressure: bounded outstanding tasks.
+            # Backpressure: bounded outstanding tasks AND bytes.
             while (
                 not exhausted
                 and len(pending) < self.max_in_flight
@@ -241,24 +275,29 @@ class StreamingExecutor:
                     _, bundle = heapq.heappop(done)
                     bundle = trim(bundle)
                     rows_emitted += bundle[1].num_rows
+                    rm.on_consumed(op_id, float(bundle[1].size_bytes))
                     yield bundle
                 return
             if not pending:
                 return
             ready, _ = ray_tpu.wait(list(pending.keys()), num_returns=1)
             for meta_ref in ready:
-                idx, block_ref = pending.pop(meta_ref)
+                idx, block_ref, est = pending.pop(meta_ref)
                 meta: BlockMetadata = ray_tpu.get(meta_ref)
+                rm.on_task_done(op_id, est, float(meta.size_bytes))
                 heapq.heappush(done, (idx, (block_ref, meta)))
             while done and done[0][0] == next_emit:
                 _, bundle = heapq.heappop(done)
                 next_emit += 1
                 bundle = trim(bundle)
                 rows_emitted += bundle[1].num_rows
+                rm.on_consumed(op_id, float(bundle[1].size_bytes))
                 yield bundle
                 if stop is not None and rows_emitted >= stop:
                     # Drop remaining work (reference: operators are
                     # interrupted once the limit is reached).
+                    for _i, _b, est in pending.values():
+                        rm.on_task_dropped(op_id, est)
                     pending.clear()
                     return
 
@@ -392,11 +431,19 @@ class StreamingExecutor:
     # --- driver ---
 
     def execute(self, segments: List[Any]) -> Iterator[Bundle]:
+        n_maps = sum(1 for s in segments if isinstance(s, MapSegment))
+        self.resource_manager = ResourceManager(
+            self.memory_budget_bytes, n_maps
+        )
         stream: Optional[Iterator[Bundle]] = None
+        op_id = 0
         for seg in segments:
             if isinstance(seg, MapSegment):
-                stream = self._run_map_segment(seg, stream)
+                stream = self._run_map_segment(seg, stream, op_id)
+                op_id += 1
             elif isinstance(seg, AllToAll):
+                # Barriers consume the whole upstream by design
+                # (reference: AllToAll operators are not streaming).
                 upstream = list(stream) if stream is not None else []
                 stream = iter(self._run_all_to_all(seg, upstream))
             else:

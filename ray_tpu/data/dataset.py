@@ -26,6 +26,9 @@ from .datasource import (
     ParquetDatasource,
     RangeDatasource,
     TFRecordsDatasource,
+    ImageDatasource,
+    SQLDatasource,
+    WebDatasetDatasource,
 )
 from ._executor import Bundle, StreamingExecutor
 
@@ -465,3 +468,33 @@ def read_tfrecords(paths, *, parallelism: int = -1, override_num_blocks=None,
     return read_datasource(TFRecordsDatasource(paths, **kw),
                            parallelism=parallelism,
                            override_num_blocks=override_num_blocks)
+
+
+def read_images(paths, *, size=None, mode=None, parallelism: int = -1,
+                override_num_blocks=None, **kw) -> Dataset:
+    """Decoded images ({"image", "path"} rows; reference:
+    read_api.py read_images)."""
+    return read_datasource(
+        ImageDatasource(paths, size=size, mode=mode, **kw),
+        parallelism=parallelism, override_num_blocks=override_num_blocks,
+    )
+
+
+def read_sql(sql: str, connection_factory, *, shard_rows: int = 0,
+             parallelism: int = -1, override_num_blocks=None) -> Dataset:
+    """Rows from any DB-API connection (reference: read_api.py
+    read_sql). ``shard_rows`` > 0 shards via LIMIT/OFFSET."""
+    return read_datasource(
+        SQLDatasource(sql, connection_factory, shard_rows=shard_rows),
+        parallelism=parallelism, override_num_blocks=override_num_blocks,
+    )
+
+
+def read_webdataset(paths, *, parallelism: int = -1,
+                    override_num_blocks=None, **kw) -> Dataset:
+    """WebDataset tar shards as {"__key__", <ext>: bytes} samples
+    (reference: read_api.py read_webdataset)."""
+    return read_datasource(
+        WebDatasetDatasource(paths, **kw),
+        parallelism=parallelism, override_num_blocks=override_num_blocks,
+    )
