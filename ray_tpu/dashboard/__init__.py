@@ -16,6 +16,12 @@ same data pipelines:
   /metrics                  Prometheus text exposition of user +
                             core-runtime metrics (reference: the node
                             metrics agent's Prometheus endpoint)
+  /api/serve/applications/  GET live app statuses / PUT a declarative
+                            config (deploys it) / DELETE all apps
+                            (reference: dashboard/modules/serve/ REST
+                            config API)
+  /api/task/{task_id}       one task's state + its timeline events
+  /api/actor/{actor_id}     one actor's state + its tasks
 
     from ray_tpu.dashboard import start_dashboard
     url = start_dashboard(port=8265)
@@ -49,6 +55,11 @@ _PAGE = """<!doctype html>
 <h2>logs (tail)</h2><pre id="logs">…</pre>
 <script>
 const KINDS = ["nodes", "workers", "actors", "tasks", "placement_groups"];
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"
+  })[c]);
+}
 function spark(points, label) {
   if (!points.length) return "";
   const w = 180, h = 40;
@@ -59,7 +70,7 @@ function spark(points, label) {
   const path = xs.map(([x, y], i) => (i ? "L" : "M") + x.toFixed(1) + " " + y.toFixed(1)).join(" ");
   return `<figure><svg class="spark" width="${w}" height="${h}">` +
     `<path d="${path}" fill="none" stroke="#36c" stroke-width="1.5"/></svg>` +
-    `<figcaption>${label} (now: ${points[points.length-1].toFixed(1)})</figcaption></figure>`;
+    `<figcaption>${esc(label)} (now: ${points[points.length-1].toFixed(1)})</figcaption></figure>`;
 }
 async function refresh() {
   const ts = await (await fetch("/api/metrics_timeseries")).json();
@@ -83,10 +94,31 @@ async function refresh() {
     html += "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") +
       (kind === "workers" ? "<th>profile</th>" : "") + "</tr>";
     for (const it of items.slice(0, 50)) {
-      html += "<tr>" + cols.map(c => `<td>${JSON.stringify(it[c])}</td>`).join("");
+      html += "<tr>" + cols.map(c => {
+        let cell = esc(JSON.stringify(it[c]));
+        if (kind === "tasks" && c === "task_id")
+          cell = `<a href="/api/task/${encodeURIComponent(it[c])}">${cell}</a>`;
+        if (kind === "actors" && c === "actor_id")
+          cell = `<a href="/api/actor/${encodeURIComponent(it[c])}">${cell}</a>`;
+        return `<td>${cell}</td>`;
+      }).join("");
       if (kind === "workers")
         html += `<td><a href="/api/profile/${it.worker_id}">stacks</a></td>`;
       html += "</tr>";
+    }
+    html += "</table>";
+  }
+  const serveApps = await (await fetch("/api/serve/applications/")).json();
+  const appNames = Object.keys(serveApps);
+  html += `<h2>serve applications (${appNames.length})</h2>`;
+  if (appNames.length) {
+    html += "<table><tr><th>app</th><th>status</th><th>route</th><th>deployments</th></tr>";
+    for (const name of appNames) {
+      const a = serveApps[name];
+      const deps = Object.entries(a.deployments)
+        .map(([d, s]) => `${esc(d)}: ${esc(s.status)} x${s.num_replicas}`).join(", ");
+      html += `<tr><td>${esc(name)}</td><td>${esc(a.status)}</td>` +
+        `<td>${esc(a.route_prefix ?? "")}</td><td>${deps}</td></tr>`;
     }
     html += "</table>";
   }
@@ -130,6 +162,17 @@ class DashboardActor:
         app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/profile/{worker_id}", self._profile)
         app.router.add_get("/metrics", self._prometheus)
+        app.router.add_get(
+            "/api/serve/applications/", self._serve_get
+        )
+        app.router.add_put(
+            "/api/serve/applications/", self._serve_put
+        )
+        app.router.add_delete(
+            "/api/serve/applications/", self._serve_delete
+        )
+        app.router.add_get("/api/task/{task_id}", self._task_detail)
+        app.router.add_get("/api/actor/{actor_id}", self._actor_detail)
         app.router.add_get("/api/{kind}", self._list)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -148,9 +191,21 @@ class DashboardActor:
         samples = {}
         for k, v in total.items():
             samples[f"{k} used"] = v - avail.get(k, 0.0)
+        nodes = ray_tpu.nodes()
         samples["nodes alive"] = float(
-            sum(1 for n in ray_tpu.nodes() if n["alive"])
+            sum(1 for n in nodes if n["alive"])
         )
+        # Per-node CPU drill-down series (reference: per-node charts in
+        # the dashboard frontend).
+        for n in nodes:
+            if not n["alive"]:
+                continue
+            # Labels default to the hostname, which co-hosted nodes
+            # share: suffix a node-id tag so series never collapse.
+            label = n.get("label") or "node"
+            tag = n["node_id"].hex()[:6]
+            used = n["total"].get("CPU", 0.0) - n["available"].get("CPU", 0.0)
+            samples[f"CPU used @ {label}:{tag}"] = used
         from ..util.state import list_workers
 
         samples["workers"] = float(len(list_workers(limit=10_000)))
@@ -257,6 +312,127 @@ class DashboardActor:
         if not reply.get("ok"):
             return web.Response(status=404, text=reply.get("error", "?"))
         return web.Response(text=reply["text"], content_type="text/plain")
+
+    # ------------------------------------------------------------- serve
+    def _serve_statuses_json(self):
+        from .. import serve
+
+        out = {}
+        for name, info in serve.status().items():
+            out[name] = {
+                "status": info.status.value,
+                "message": info.message,
+                "route_prefix": info.route_prefix,
+                "deployments": {
+                    d: {
+                        "status": s.status.value,
+                        "message": s.message,
+                        "num_replicas": s.num_replicas,
+                    }
+                    for d, s in info.deployments.items()
+                },
+            }
+        return out
+
+    async def _serve_get(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        try:
+            return web.json_response(
+                await asyncio.to_thread(self._serve_statuses_json)
+            )
+        except ValueError:  # controller actor not found: serve not started
+            return web.json_response({})
+
+    async def _serve_put(self, request):
+        """Declarative deploy over HTTP: the same schema as `serve
+        deploy config.yaml` (reference: dashboard/modules/serve PUT
+        /api/serve/applications/)."""
+        import asyncio
+
+        from aiohttp import web
+
+        from ..serve.schema import deploy_config
+
+        def deploy(config):
+            deploy_config(config, _blocking=True)
+            return self._serve_statuses_json()
+
+        try:
+            config = await request.json()
+            return web.json_response(
+                await asyncio.to_thread(deploy, config)
+            )
+        except Exception as e:  # noqa: BLE001 - bad body/config -> 400
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=400
+            )
+
+    async def _serve_delete(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        from .. import serve
+
+        await asyncio.to_thread(serve.shutdown)
+        return web.Response(status=204)
+
+    # --------------------------------------------------------- drill-down
+    async def _task_detail(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        from .._private import state as _state
+        from ..util.state import list_tasks
+
+        tid = request.match_info["task_id"]
+
+        def build():
+            # EXACT match only: ids are process-prefix + counter, so a
+            # truncated prefix matches every id from that driver.
+            rows = [
+                t for t in list_tasks(limit=10_000)
+                if t.get("task_id", "") == tid
+            ]
+            events = [
+                e for e in _state.task_events()
+                if e.get("task_id", "") == tid
+            ]
+            return {"task": rows[0] if rows else None, "events": events}
+
+        detail = await asyncio.to_thread(build)
+        if detail["task"] is None and not detail["events"]:
+            return web.Response(status=404, text=f"no task {tid}")
+        return web.json_response(detail)
+
+    async def _actor_detail(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        from ..util.state import list_actors, list_tasks
+
+        aid = request.match_info["actor_id"]
+
+        def build():
+            rows = [
+                a for a in list_actors(limit=10_000)
+                if a.get("actor_id", "") == aid
+            ]
+            tasks = [
+                t for t in list_tasks(limit=10_000)
+                if t.get("actor_id", "") == aid
+            ]
+            return {"actor": rows[0] if rows else None, "tasks": tasks}
+
+        detail = await asyncio.to_thread(build)
+        if detail["actor"] is None:
+            return web.Response(status=404, text=f"no actor {aid}")
+        return web.json_response(detail)
 
     # -------------------------------------------------------- prometheus
     async def _prometheus(self, request):
