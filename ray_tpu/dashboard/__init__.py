@@ -20,6 +20,8 @@ same data pipelines:
                             config (deploys it) / DELETE all apps
                             (reference: dashboard/modules/serve/ REST
                             config API)
+  /api/workflow/events/{k}  POST fires a workflow event (reference:
+                            workflow/http_event_provider.py)
   /api/task/{task_id}       one task's state + its timeline events
   /api/actor/{actor_id}     one actor's state + its tasks
 
@@ -170,6 +172,9 @@ class DashboardActor:
         )
         app.router.add_delete(
             "/api/serve/applications/", self._serve_delete
+        )
+        app.router.add_post(
+            "/api/workflow/events/{key:.+}", self._workflow_event
         )
         app.router.add_get("/api/task/{task_id}", self._task_detail)
         app.router.add_get("/api/actor/{actor_id}", self._actor_detail)
@@ -379,6 +384,26 @@ class DashboardActor:
 
         await asyncio.to_thread(serve.shutdown)
         return web.Response(status=204)
+
+    async def _workflow_event(self, request):
+        """HTTP event provider (reference: workflow/
+        http_event_provider.py): POST a JSON payload to fire the event
+        any waiting workflow node resolves to."""
+        import asyncio
+
+        from aiohttp import web
+
+        from ..workflow import post_event
+
+        key = request.match_info["key"]
+        try:
+            payload = await request.json() if request.can_read_body else None
+        except Exception as e:  # noqa: BLE001 - malformed body -> 400
+            return web.json_response(
+                {"error": f"{type(e).__name__}: {e}"}, status=400
+            )
+        await asyncio.to_thread(post_event, key, payload)
+        return web.json_response({"ok": True, "key": key})
 
     # --------------------------------------------------------- drill-down
     async def _task_detail(self, request):

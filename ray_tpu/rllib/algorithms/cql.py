@@ -1,0 +1,211 @@
+"""CQL: conservative Q-learning for offline RL.
+
+Reference: rllib/algorithms/cql/ — SAC's actor/twin-critic/temperature
+machinery trained purely from a recorded dataset, with the CQL(H)
+conservative regularizer pushing Q down on out-of-distribution actions
+(logsumexp over random + policy actions, importance-corrected) and up
+on dataset actions, plus a behavior-cloning warm-start for the actor.
+Rides the same offline IO as MARWIL/BC and the SAC learner's combined
+single-jit update; the conservative term adds only batched MXU matmuls
+(tiled (s, a') critic sweeps), so the whole step stays one device
+program.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..core.rl_module import Columns
+from ..utils.replay_buffers import ReplayBuffer
+from .sac import SAC, SACConfig, SACLearner
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.input_: Any = None  # offline sample dir (rllib "input")
+        # CQL(H) knobs (reference: cql/cql.py defaults).
+        self.cql_n_actions = 4  # sampled actions per source per state
+        self.min_q_weight = 5.0
+        self.bc_iters = 200  # actor warm-start: BC before SAC objective
+        self.num_steps_sampled_before_learning_starts = 0
+
+    @property
+    def algo_class(self):
+        return CQL
+
+    def offline_data(self, *, input_=None) -> "CQLConfig":
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def learner_config(self):
+        cfg = super().learner_config()
+        cfg.update(
+            cql_n_actions=self.cql_n_actions,
+            min_q_weight=self.min_q_weight,
+        )
+        return cfg
+
+
+class CQLLearner(SACLearner):
+    """SAC losses + the conservative regularizer; `bc_phase` rides in
+    the batch as a traced scalar so warm-start vs SAC actor objectives
+    switch without recompiling."""
+
+    def compute_loss(self, params, batch, rng) -> Tuple[Any, Dict[str, Any]]:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        stop = jax.lax.stop_gradient
+        total, metrics = super().compute_loss(params, batch, rng)
+
+        obs = batch[Columns.OBS]
+        next_obs = batch[Columns.NEXT_OBS]
+        actions = batch[Columns.ACTIONS]
+        if actions.ndim == 1:
+            actions = actions[:, None]
+        B = obs.shape[0]
+        A = self.module.num_actions()
+        N = int(cfg["cql_n_actions"])
+        rng_r, rng_pi, rng_pi2 = jax.random.split(
+            jax.random.fold_in(rng, 1), 3
+        )
+
+        def tile(x):
+            return jnp.repeat(x, N, axis=0)  # [N*B, ...]
+
+        scale = jnp.asarray(self.module.action_scale, jnp.float32)
+        center = jnp.asarray(self.module.action_center, jnp.float32)
+
+        # Random actions, importance-corrected by the uniform density.
+        rand_a = (
+            jax.random.uniform(rng_r, (N * B, A), minval=-1.0, maxval=1.0)
+            * scale
+            + center
+        )
+        logp_rand = -jnp.sum(jnp.log(2.0 * scale))
+        # Policy actions at s and s' (reparameterized, density-corrected).
+        a_pi, logp_pi = self.module.sample_action(params, tile(obs), rng_pi)
+        a_pi2, logp_pi2 = self.module.sample_action(
+            params, tile(next_obs), rng_pi2
+        )
+
+        def cat_q(qname):
+            frozen = {qname: params[qname]}
+
+            def q(o, a):
+                oa = jnp.concatenate(
+                    [o.reshape(o.shape[0], -1), a], axis=-1
+                )
+                return getattr(self.module, f"_{qname}").apply(
+                    frozen[qname], oa
+                )[..., 0]
+
+            # tile() = repeat along axis 0: flat index k = b*N + n, so
+            # the [N, B] view is reshape(B, N).T — reshape(N, B) would
+            # mix DIFFERENT states into one logsumexp column.
+            def nb(v):
+                return v.reshape(B, N).T
+
+            q_rand = nb(q(tile(obs), rand_a)) - logp_rand
+            q_p = nb(q(tile(obs), stop(a_pi))) - nb(stop(logp_pi))
+            q_p2 = nb(q(tile(obs), stop(a_pi2))) - nb(stop(logp_pi2))
+            return jnp.concatenate([q_rand, q_p, q_p2], axis=0)  # [3N, B]
+
+        q1_data, q2_data = self.module.q_values(params, obs, actions)
+        cql1 = jnp.mean(
+            jax.scipy.special.logsumexp(cat_q("q1"), axis=0) - q1_data
+        )
+        cql2 = jnp.mean(
+            jax.scipy.special.logsumexp(cat_q("q2"), axis=0) - q2_data
+        )
+        conservative = cfg["min_q_weight"] * (cql1 + cql2)
+
+        # BC warm-start: replace the SAC actor objective with the
+        # dataset-action log-likelihood for the first bc_iters updates
+        # (bc_phase is 1.0 then 0.0 — a traced scalar, no recompile).
+        bc_phase = batch.get("bc_phase", jnp.asarray(0.0))
+        dist = self.module._pi.apply(params["pi"], obs)
+        mean, log_std = jnp.split(dist, 2, axis=-1)
+        log_std = jnp.clip(log_std, -20.0, 2.0)
+        # Invert the tanh squash on dataset actions (clipped for
+        # numerical safety at the bounds).
+        u_data = jnp.arctanh(
+            jnp.clip((actions - center) / scale, -0.999999, 0.999999)
+        )
+        bc_logp = jnp.sum(
+            -0.5 * jnp.square((u_data - mean) / jnp.exp(log_std))
+            - log_std
+            - 0.5 * jnp.log(2.0 * jnp.pi),
+            axis=-1,
+        )
+        bc_loss = -jnp.mean(bc_logp)
+        # total already includes the SAC actor loss; fade it out during
+        # the BC phase by adding (bc - actor) weighted by bc_phase.
+        total = total + conservative + bc_phase * (
+            bc_loss - metrics["actor_loss"]
+        )
+        metrics.update(
+            cql_loss=conservative, bc_loss=bc_loss, bc_phase=bc_phase
+        )
+        return total, metrics
+
+
+class CQL(SAC):
+    """Offline: the replay buffer is loaded once from the dataset and
+    the env runners are used only by evaluate()."""
+
+    learner_class = CQLLearner
+
+    def setup(self, config_dict) -> None:
+        super().setup(config_dict)
+        cfg = self.config
+        if not cfg.input_:
+            raise ValueError(
+                "CQL is an offline algorithm: set "
+                "config.offline_data(input_=<sample dir>)"
+            )
+        from ..offline import SampleReader
+
+        episodes = SampleReader(cfg.input_, seed=cfg.seed).read_all()
+        # Offline training wants the whole dataset resident; grow past
+        # the configured capacity only as far as the data requires.
+        n_transitions = sum(len(ep) for ep in episodes)
+        self.replay = ReplayBuffer(
+            max(cfg.replay_buffer_capacity, n_transitions), seed=cfg.seed
+        )
+        self.replay.add_episodes(episodes)
+        self._updates = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        assert self.learner_group.is_local
+        learner: CQLLearner = self.learner_group._local
+        metrics_list = []
+        for _ in range(cfg.updates_per_iteration):
+            batch = self.replay.sample(cfg.train_batch_size)
+            batch.pop("batch_indexes", None)
+            batch["bc_phase"] = np.float32(
+                1.0 if self._updates < cfg.bc_iters else 0.0
+            )
+            metrics_list.append(learner.update(dict(batch)))
+            self._updates += 1
+        # No env sampling during training; evaluate() syncs weights.
+        return {
+            k: float(np.mean([m[k] for m in metrics_list]))
+            for k in metrics_list[0]
+        }
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        episodes = self.env_runner_group.sample(
+            num_episodes=num_episodes, explore=False
+        )
+        returns = [float(np.sum(ep.rewards)) for ep in episodes]
+        return {
+            "episode_return_mean": float(np.mean(returns)),
+            "num_episodes": len(returns),
+        }

@@ -7,6 +7,7 @@ tasks, giving exactly-once semantics across driver crashes.
 """
 from __future__ import annotations
 
+from .events import get_event, post_event, wait_for_event  # noqa: F401
 from .api import (  # noqa: F401
     cancel,
     delete,
@@ -21,6 +22,9 @@ from .api import (  # noqa: F401
 
 __all__ = [
     "cancel",
+    "get_event",
+    "post_event",
+    "wait_for_event",
     "delete",
     "get_output",
     "get_status",

@@ -191,3 +191,70 @@ def test_workflow_delete(cluster, tmp_path):
     workflow.run(add.bind(1, 2), workflow_id="w4")
     workflow.delete("w4")
     assert workflow.get_status("w4") is None
+
+
+# ------------------------------------------------------- event triggers
+
+def test_workflow_waits_for_posted_event(cluster, tmp_path):
+    """wait_for_event blocks the DAG until post_event fires; the
+    payload flows into downstream tasks (reference:
+    workflow/event_listener.py semantics)."""
+    import time as _time
+
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf_events"))
+
+    @ray_tpu.remote
+    def consume(evt):
+        return f"paid={evt['paid']} amount={evt['amount']}"
+
+    node = consume.bind(workflow.wait_for_event("order/42", timeout_s=60))
+    fut = workflow.run_async(node, workflow_id="order-42")
+    _time.sleep(0.5)
+    assert not fut.done()  # genuinely waiting, not racing through
+    workflow.post_event("order/42", {"paid": True, "amount": 7})
+    assert fut.result(timeout=60) == "paid=True amount=7"
+    # Durable: the completed wait node persisted; resume re-delivers
+    # without re-waiting.
+    assert workflow.resume("order-42") == "paid=True amount=7"
+
+
+def test_workflow_event_over_http(cluster, tmp_path):
+    """The HTTP provider: POST to the dashboard fires the event."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu import workflow
+    from ray_tpu.dashboard import start_dashboard
+
+    workflow.init(str(tmp_path / "wf_http"))
+    url = start_dashboard(port=18281)
+
+    @ray_tpu.remote
+    def consume(evt):
+        return evt["source"]
+
+    fut = workflow.run_async(
+        consume.bind(workflow.wait_for_event("deploy/done", timeout_s=60)),
+        workflow_id="http-evt",
+    )
+    req = urllib.request.Request(
+        f"{url}/api/workflow/events/deploy/done",
+        method="POST",
+        data=_json.dumps({"source": "ci-pipeline"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert _json.loads(r.read())["ok"]
+    assert fut.result(timeout=60) == "ci-pipeline"
+
+
+def test_workflow_event_timeout(cluster, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.exceptions import RayTaskError  # noqa: F401
+
+    workflow.init(str(tmp_path / "wf_timeout"))
+    node = workflow.wait_for_event("never/fires", timeout_s=1.0)
+    with pytest.raises(Exception, match="not posted within"):
+        workflow.run(node, workflow_id="evt-timeout")
