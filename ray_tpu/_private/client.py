@@ -336,7 +336,19 @@ class CoreClient:
             and spec.scheduling_strategy is None
             and not spec.retry_exceptions
             and spec.function_blob is None  # first call registers via GCS
-            and spec.resources.get("TPU", 0) == 0
+            # Single-chip TPU tasks lease from the LOCAL raylet only
+            # (its pool assigns each worker a dedicated chip); larger
+            # shapes need the GCS's quantity accounting.
+            and (
+                spec.resources.get("TPU", 0) == 0
+                or (
+                    # Exactly one whole chip: local slots are chip-
+                    # granular; fractional requests need the GCS's
+                    # float quantity accounting.
+                    spec.resources.get("TPU", 0) == 1
+                    and os.environ.get("RAY_TPU_LOCAL_RAYLET")
+                )
+            )
         )
 
     def submit_task_leased(self, spec: TaskSpec) -> Optional[List[ObjectRef]]:
@@ -407,21 +419,32 @@ class CoreClient:
         # Local dispatch first (reference: tasks submitted on a node
         # lease from its raylet, not the head — cluster_task_manager):
         # one node-local hop, the head never sees the dispatch.
-        # Local slots are single-CPU: multi-CPU shapes need the GCS's
-        # quantity accounting (_fits/_acquire), not a 1-slot grant.
+        # Local slots are single-unit: multi-CPU/TPU shapes need the
+        # GCS's quantity accounting (_fits/_acquire), not a 1-slot
+        # grant.
+        tpu_shape = bool(resources) and resources.get("TPU", 0) > 0
         simple_shape = not resources or (
-            set(resources) == {"CPU"} and resources.get("CPU", 1) <= 1
+            set(resources) <= {"CPU", "TPU"}
+            and resources.get("CPU", 0) <= 1
+            and resources.get("TPU", 0) in (0, 1)
         )
         rconn = self._raylet_conn() if simple_shape else None
         if rconn is not None:
             try:
-                reply = rconn.request({"type": "lease_worker"}, timeout=5)
+                reply = rconn.request(
+                    {"type": "lease_worker", "resources": resources},
+                    timeout=5,
+                )
             except (ConnectionLost, TimeoutError):
                 reply = None
             if reply and reply.get("ok") and reply.get("addr"):
                 lease = self._connect_lease(key, reply, raylet=True)
                 if lease is not None:
                     return lease
+        if tpu_shape:
+            # The head's lease pool is CPU-only; TPU tasks the local
+            # raylet cannot serve take the GCS submit route.
+            return None
         try:
             reply = self.conn.request(
                 {"type": "lease_worker", "resources": resources}

@@ -157,6 +157,7 @@ class NodeState:
     # CPUs the node's daemon has leased to local clients, synced via
     # heartbeats (the daemon's local dispatch authority).
     local_cpus_in_use: float = 0.0
+    local_tpus_in_use: float = 0.0
 
 
 @dataclass
@@ -1669,13 +1670,18 @@ class GcsServer:
                 # resource broadcasting): CPUs the daemon leased out
                 # locally come off this node's schedulable view,
                 # eventually-consistently.
-                local = msg.get("local_cpus_in_use")
-                if local is not None:
-                    delta = local - node.local_cpus_in_use
+                for field_name, res in (
+                    ("local_cpus_in_use", "CPU"),
+                    ("local_tpus_in_use", "TPU"),
+                ):
+                    local = msg.get(field_name)
+                    if local is None:
+                        continue
+                    delta = local - getattr(node, field_name)
                     if delta:
-                        node.local_cpus_in_use = local
-                        node.available["CPU"] = (
-                            node.available.get("CPU", 0.0) - delta
+                        setattr(node, field_name, local)
+                        node.available[res] = (
+                            node.available.get(res, 0.0) - delta
                         )
                         if delta < 0:
                             self._work.notify_all()

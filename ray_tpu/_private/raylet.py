@@ -139,7 +139,14 @@ class NodeDaemon:
         # own worker pool to local clients without a head round-trip;
         # leased CPUs sync to the GCS resource view via heartbeats.
         self._local_workers: Dict[bytes, Dict] = {}
-        self._local_leased = 0
+        # Leased-out counts by worker kind; feeds the heartbeat's
+        # local_*_in_use resource-view sync.
+        self._leased_count = {"cpu": 0, "tpu": 0}
+        # TPU chip slots (one chip per TPU worker, local or head-
+        # routed; TPU_VISIBLE_CHIPS pins each worker to its chip).
+        # Grown on demand — chips are too valuable to prestart on.
+        self._tpu_slots = int(self.resources.get("TPU", 0))
+        self._chip_owner: Dict[int, bytes] = {}  # chip -> worker id
         self._lease_addr = f"/tmp/rtpu-rl-{self.node_ns.rstrip('_')}.sock"
         try:
             os.unlink(self._lease_addr)
@@ -203,15 +210,56 @@ class NodeDaemon:
         }
         if msg.get("local_only"):
             env["RAY_TPU_LOCAL_ONLY"] = "1"
+        chips = msg.get("visible_chips")
+        if chips is None and msg.get("tpu") and self._tpu_slots:
+            # Head-routed TPU spawn: this daemon owns chip identity on
+            # its node — assign a free chip so head-scheduled and
+            # locally-leased workers never initialize the same device.
+            chip = self._assign_chip(wid.binary())
+            chips = None if chip is None else [chip]
+        if chips is not None:
+            from .accelerators.tpu import TPUAcceleratorManager
+
+            TPUAcceleratorManager.set_visible_accelerator_ids(
+                env, [str(c) for c in chips]
+            )
+            with self._lock:
+                self._chip_owner.update(
+                    {int(c): wid.binary() for c in chips}
+                )
         os.makedirs(self.logs_dir, exist_ok=True)
         log_path = os.path.join(self.logs_dir, f"worker-{wid.hex()[:8]}.out")
         proc = self._spawner.spawn(env, log_path, tpu=bool(msg.get("tpu")))
         with self._lock:
             self._workers[wid.binary()] = proc
 
+    def _assign_chip_locked(self, wid: bytes):
+        """Caller holds self._lock."""
+        for c in range(self._tpu_slots):
+            owner = self._chip_owner.get(c)
+            if owner is None or self._worker_dead(owner):
+                self._chip_owner[c] = wid
+                return c
+        return None  # overcommitted: spawn unrestricted (legacy shape)
+
+    def _assign_chip(self, wid: bytes):
+        with self._lock:
+            return self._assign_chip_locked(wid)
+
+    def _worker_dead(self, wid: bytes) -> bool:
+        proc = self._workers.get(wid)
+        return proc is None or proc.poll() is not None
+
+    def _free_chips(self, wid: bytes):
+        with self._lock:
+            for c, owner in list(self._chip_owner.items()):
+                if owner == wid:
+                    del self._chip_owner[c]
+
     def _kill_worker(self, wid: bytes):
         with self._lock:
             proc = self._workers.pop(wid, None)
+        self._free_chips(wid)
         if proc is not None:
             proc.terminate()
 
@@ -229,9 +277,19 @@ class NodeDaemon:
             with self._lock:
                 self._local_workers[wid.binary()] = {
                     "state": "starting", "addr": None, "proc": None,
+                    "tpu": False, "chip": None,
                 }
+        with self._lock:
+            rec0 = self._local_workers.get(wid.binary(), {})
+            tpu = bool(rec0.get("tpu"))
+            chip = rec0.get("chip")
         self._spawn_worker(
-            {"worker_id": wid.binary(), "tpu": False, "local_only": True}
+            {
+                "worker_id": wid.binary(),
+                "tpu": tpu,
+                "local_only": True,
+                "visible_chips": None if chip is None else [chip],
+            }
         )
         with self._lock:
             rec = self._local_workers.get(wid.binary())
@@ -282,13 +340,18 @@ class NodeDaemon:
                 except ConnectionLost:
                     pass
                 return
+            wants_tpu = (msg.get("resources") or {}).get("TPU", 0) > 0
             granted = None
             spawn_wid = None
             with self._lock:
                 for wid, rec in self._local_workers.items():
-                    if rec["state"] == "idle":
+                    if rec["state"] == "idle" and bool(
+                        rec.get("tpu")
+                    ) == wants_tpu:
                         rec["state"] = "leased"
-                        self._local_leased += 1
+                        self._leased_count[
+                            "tpu" if wants_tpu else "cpu"
+                        ] += 1
                         granted = (wid, rec["addr"])
                         holder["held"].add(wid)
                         break
@@ -297,13 +360,33 @@ class NodeDaemon:
                         1
                         for r in self._local_workers.values()
                         if r["state"] != "dead"
+                        and bool(r.get("tpu")) == wants_tpu
                     )
-                    if live < int(self.resources.get("CPU", 0)):
+                    cap = int(
+                        self._tpu_slots
+                        if wants_tpu
+                        else self.resources.get("CPU", 0)
+                    )
+                    if live < cap:
                         # Reserve the slot under the lock so concurrent
-                        # denials can't overshoot the CPU cap.
+                        # denials can't overshoot the cap. TPU workers
+                        # get a dedicated chip (slot index) so local
+                        # leases never share a device.
                         w = WorkerID(os.urandom(16))
+                        chip = None
+                        if wants_tpu:
+                            chip = self._assign_chip_locked(w.binary())
+                            if chip is None:
+                                # All chips owned (e.g. by head-routed
+                                # workers): deny; the GCS route queues.
+                                try:
+                                    peer.reply(msg, ok=False)
+                                except ConnectionLost:
+                                    pass
+                                return
                         self._local_workers[w.binary()] = {
                             "state": "starting", "addr": None, "proc": None,
+                            "tpu": wants_tpu, "chip": chip,
                         }
                         spawn_wid = w
             try:
@@ -334,13 +417,17 @@ class NodeDaemon:
             rec = self._local_workers.get(wid)
             if rec is not None and rec["state"] == "leased":
                 rec["state"] = "idle"
-                self._local_leased -= 1
+                self._leased_count[
+                    "tpu" if rec.get("tpu") else "cpu"
+                ] -= 1
             proc = rec.get("proc") if rec else None
         if proc is not None and proc.poll() is not None:
             with self._lock:
                 if rec["state"] != "dead":
                     if rec["state"] == "leased":
-                        self._local_leased -= 1
+                        self._leased_count[
+                            "tpu" if rec.get("tpu") else "cpu"
+                        ] -= 1
                     rec["state"] = "dead"
 
     # ------------------------------------------------------------ lifecycle
@@ -353,7 +440,12 @@ class NodeDaemon:
                     {
                         "type": "node_heartbeat",
                         "node_id": self.node_id,
-                        "local_cpus_in_use": float(self._local_leased),
+                        "local_cpus_in_use": float(
+                            self._leased_count["cpu"]
+                        ),
+                        "local_tpus_in_use": float(
+                            self._leased_count["tpu"]
+                        ),
                     }
                 )
             except ConnectionLost:
