@@ -31,13 +31,14 @@ def flops_per_token(n_params: float, cfg, seq_len: int) -> float:
     return 6.0 * n_params + 12.0 * cfg.num_layers * seq_len * cfg.hidden_size
 
 
-def bench_model(model, cfg, n_params, batch, seq, steps, peak_flops):
+def bench_model(model, cfg, n_params, batch, seq, steps, peak_flops,
+                chunked_loss: bool = False):
     import jax
     import numpy as np
     import jax.numpy as jnp
     import optax
 
-    from ray_tpu.models.llama import causal_lm_loss
+    from ray_tpu.models.llama import causal_lm_loss, chunked_causal_lm_loss
 
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)), jnp.int32)
@@ -52,6 +53,11 @@ def bench_model(model, cfg, n_params, batch, seq, steps, peak_flops):
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, ids, targets):
         def loss_fn(p):
+            if chunked_loss:
+                # Long context: the [B, T, V] logits tensor would be
+                # the biggest activation (4.2 GB f32 at 32k/32k);
+                # chunk the head + softmax-xent over the sequence.
+                return chunked_causal_lm_loss(model, p, ids, targets)
             return causal_lm_loss(model.apply(p, ids), targets)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -116,7 +122,7 @@ def main() -> int:
             try:
                 lc_tok, lc_mfu, lc_loss = bench_model(
                     LlamaForCausalLM(cfg), cfg, cfg.num_params(), 1, lc_seq,
-                    max(5, steps // 2), peak_flops,
+                    max(5, steps // 2), peak_flops, chunked_loss=True,
                 )
             except Exception as exc:  # RESOURCE_EXHAUSTED at the top end
                 if not points:

@@ -192,7 +192,12 @@ class LlamaForCausalLM(nn.Module):
     mesh: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, return_hidden=False):
+        """``return_hidden=True`` yields the final-norm hidden states
+        instead of logits, so a chunked loss can apply the LM head
+        per sequence chunk — at long T the full [B, T, V] logits
+        tensor (4.2 GB in f32 at T=32k, V=32k) is the single biggest
+        activation and never needs to exist."""
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -225,6 +230,8 @@ class LlamaForCausalLM(nn.Module):
         for i in range(cfg.num_layers):
             x = layer_cls(cfg, mesh=self.mesh, name=f"layers_{i}")(x, positions)
         x = RMSNorm(cfg.rms_eps, cfg.param_dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
         if cfg.tie_embeddings:
             logits = emb.attend(x.astype(cfg.param_dtype))
         else:
@@ -233,6 +240,82 @@ class LlamaForCausalLM(nn.Module):
                 param_dtype=cfg.param_dtype, name="lm_head",
             )(x)
         return logits
+
+
+def lm_head_weight(params) -> jax.Array:
+    """[V, H] output-projection weight from a param tree (tied
+    embedding table, or the dedicated lm_head kernel transposed)."""
+    p = params.get("params", params)
+    if "lm_head" in p:
+        return p["lm_head"]["kernel"].T
+    return p["embed_tokens"]["embedding"]
+
+
+def chunked_causal_lm_loss(
+    model,
+    params,
+    input_ids: jax.Array,
+    targets: jax.Array,
+    mask: Optional[jax.Array] = None,
+    chunk_size: int = 2048,
+) -> jax.Array:
+    """Next-token cross-entropy without materializing full logits.
+
+    The [B, T, V] logits tensor is the largest activation at long T
+    (f32 T=32k, V=32k is 4.2 GB — bigger than the whole remat'd
+    transformer). Scanning the LM head + softmax-xent over sequence
+    chunks keeps only [B, chunk, V] alive; jax.checkpoint recomputes
+    each chunk's logits in the backward, so the memory bound holds
+    end-to-end. Net-new vs the reference (its torch trainers
+    materialize logits); the standard long-context recipe on TPU.
+    """
+    b, t = targets.shape
+    hidden = model.apply(params, input_ids, return_hidden=True)
+    head = lm_head_weight(params)  # [V, H]
+    if mask is None:
+        m_full = jnp.ones((b, t), jnp.float32)
+    else:
+        m_full = jnp.broadcast_to(
+            mask.astype(jnp.float32), targets.shape
+        )
+    chunk_size = min(chunk_size, t)
+    pad = (-t) % chunk_size
+    if pad:
+        # Pad to a whole number of chunks; padded rows carry mask 0 so
+        # they never contribute (odd lengths must not collapse the
+        # chunking into per-token scan steps).
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        m_full = jnp.pad(m_full, ((0, 0), (0, pad)))
+        t += pad
+    n_chunks = t // chunk_size
+    h_c = hidden.reshape(b, n_chunks, chunk_size, -1).swapaxes(0, 1)
+    t_c = targets.reshape(b, n_chunks, chunk_size).swapaxes(0, 1)
+    m_c = m_full.reshape(b, n_chunks, chunk_size).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_nll(h, tg, m):
+        # f32 accumulation on the MXU regardless of param dtype — the
+        # full path's lm_head computes f32 logits, and the two losses
+        # must stay numerically comparable.
+        logits = jnp.matmul(
+            h.astype(head.dtype),
+            head.T,
+            preferred_element_type=jnp.float32,
+        )  # [B, C, V] f32
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tg[..., None], axis=-1)[..., 0]
+        return jnp.sum((logz - gold) * m), jnp.sum(m)
+
+    def body(carry, inp):
+        nll, cnt = chunk_nll(*inp)
+        return (carry[0] + nll, carry[1] + cnt), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (h_c, t_c, m_c),
+    )
+    return total / jnp.maximum(count, 1.0)
 
 
 def causal_lm_loss(logits: jax.Array, targets: jax.Array,
@@ -244,5 +327,8 @@ def causal_lm_loss(logits: jax.Array, targets: jax.Array,
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - gold
     if mask is not None:
+        # Broadcast BEFORE the sums: a shared [1, T] mask must weight
+        # the denominator per batch row too, or the mean is scaled by B.
+        mask = jnp.broadcast_to(mask.astype(nll.dtype), nll.shape)
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)
