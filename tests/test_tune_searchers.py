@@ -223,6 +223,9 @@ def test_queue_searcher_not_capped_by_default_num_samples(tmp_path):
             mode="min",
             search_alg=alg,
             experiment_dir=str(tmp_path / "exp"),
+            # Explicit: the default consults cluster_resources(), which
+            # would auto-init (and leak) a cluster in this unit test.
+            max_concurrent_trials=1,
             **kw,
         )
 
