@@ -274,12 +274,35 @@ class GcsServer:
         # specs, queued tasks re-dispatch.
         self._version = 0
         self._persisted_version = 0
+        # Segmented persistence (reference: the Redis store is keyed
+        # per table): each durable table carries its own version, and
+        # the persist loop rewrites ONLY dirty tables — a KV put no
+        # longer re-serializes every actor and sealed object. Within-
+        # table writes stay O(table); cross-table write amplification
+        # is gone.
+        self._table_versions = {t: 0 for t in self._TABLES}
+        self._persisted_table_versions = dict(self._table_versions)
         self._state_path = os.path.join(session_dir, "gcs_state.pkl")
-        if os.path.exists(self._state_path):
-            try:
-                self._restore_state()
-            except Exception as e:  # noqa: BLE001 - corrupt snapshot
-                sys.stderr.write(f"gcs: state restore failed: {e}\n")
+        self._state_dir = os.path.join(session_dir, "gcs_state.d")
+        # manifest table -> persisted filename; replaced atomically
+        # LAST each persist tick, so restarts always see a consistent
+        # cross-table cut (table files are versioned, never rewritten
+        # in place).
+        self._manifest: Dict[str, str] = {}
+        try:
+            restored_legacy = self._restore_state()
+            if restored_legacy:
+                # Seed the segmented store from the legacy snapshot:
+                # every table is dirty, so the first persist tick
+                # writes the full set (otherwise a later restart would
+                # prefer a PARTIAL gcs_state.d and drop the rest).
+                self._version += 1
+                for t in self._TABLES:
+                    self._table_versions[t] += 1
+        except FileNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 - corrupt snapshot
+            sys.stderr.write(f"gcs: state restore failed: {e}\n")
 
         try:
             os.unlink(address)  # stale socket from a previous head
@@ -439,6 +462,10 @@ class GcsServer:
                 # retaken; unlocked bumps could lose increments.
                 with self._lock:
                     self._version += 1
+                    for t in self._TABLES_OF_TYPE.get(
+                        mtype, self._TABLES
+                    ):
+                        self._table_versions[t] += 1
         except Exception as e:  # noqa: BLE001
             peer = state["peer"]
             if "req_id" in msg:
@@ -1690,6 +1717,50 @@ class GcsServer:
 
     # Message types that mutate durable state; _dispatch bumps the
     # version so the persist loop knows to re-snapshot.
+    #: Durable tables; each persists to its own file under
+    #: gcs_state.d/ and rewrites only when its version moves.
+    _TABLES = (
+        "kv", "functions", "named_actors", "actors", "pending",
+        "orphans", "placement_groups", "objects",
+    )
+    #: Which tables each durable message type can touch; unmapped
+    #: types conservatively dirty everything.
+    _TABLES_OF_TYPE = {
+        "kv_put": ("kv",),
+        "kv_del": ("kv",),
+        "register_function": ("functions",),
+        "put_object": ("objects",),
+        "free_objects": ("objects",),
+        "update_refs": ("objects",),
+        "stream_item": ("objects",),
+        "create_placement_group": ("placement_groups",),
+        "remove_placement_group": ("placement_groups",),
+        "reserve_actor_name": ("named_actors", "actors"),
+        # release/exit/kill fail queued tasks -> FAILED object entries
+        # and popped orphans/pending ride along.
+        "release_actor_name": (
+            "named_actors", "actors", "objects", "orphans", "pending",
+        ),
+        "actor_exit": (
+            "actors", "named_actors", "orphans", "objects", "pending",
+        ),
+        "kill_actor": (
+            "actors", "named_actors", "orphans", "objects", "pending",
+        ),
+        # submit_task also extracts spec-embedded function blobs into
+        # the functions table and can reserve actor names.
+        "submit_task": (
+            "pending", "actors", "objects", "orphans", "functions",
+            "named_actors",
+        ),
+        # A failed actor-creation task_done also drops the actor's
+        # name binding.
+        "task_done": ("objects", "actors", "pending", "named_actors"),
+        "task_done_batch": (
+            "objects", "actors", "pending", "named_actors",
+        ),
+    }
+
     _DURABLE_TYPES = frozenset(
         (
             "kv_put", "kv_del", "register_function", "submit_task",
@@ -1700,19 +1771,22 @@ class GcsServer:
         )
     )
 
-    def _snapshot_state(self) -> Dict[str, Any]:
-        """Durable view of the GCS tables. Caller holds the lock.
+    def _snapshot_table(self, table: str) -> Any:
+        """One durable table's persistable view. Caller holds the lock.
 
         Worker/node bindings are deliberately excluded: daemons
         re-register on reconnect, actors restart from their creation
         specs (state is lost across a head failover unless the actor
         checkpoints — same contract the reference documents for
         non-persistent actors)."""
-        return {
-            "kv": {ns: dict(d) for ns, d in self.kv.items()},
-            "functions": dict(self.functions),
-            "named_actors": dict(self.named_actors),
-            "actors": {
+        if table == "kv":
+            return {ns: dict(d) for ns, d in self.kv.items()}
+        if table == "functions":
+            return dict(self.functions)
+        if table == "named_actors":
+            return dict(self.named_actors)
+        if table == "actors":
+            return {
                 aid: {
                     "spec": a.spec,
                     "state": a.state,
@@ -1722,17 +1796,20 @@ class GcsServer:
                     "pending": list(a.pending),
                 }
                 for aid, a in self.actors.items()
-            },
-            "pending": list(self._pending),
-            "orphans": {
+            }
+        if table == "pending":
+            return list(self._pending)
+        if table == "orphans":
+            return {
                 aid: list(specs)
                 for aid, specs in self._orphan_actor_tasks.items()
-            },
+            }
+        if table == "placement_groups":
             # Bundle reservations are node-bound and die with the old
             # head's node table; persist the PG definitions and restore
-            # them PENDING so the reservation loop re-places them on the
-            # re-registered nodes.
-            "placement_groups": {
+            # them PENDING so the reservation loop re-places them on
+            # the re-registered nodes.
+            return {
                 pid: {
                     "bundles": [dict(b.resources) for b in pg.bundles],
                     "strategy": pg.strategy,
@@ -1740,15 +1817,21 @@ class GcsServer:
                     "name": pg.name,
                 }
                 for pid, pg in self.placement_groups.items()
-            },
-            "objects": {
+            }
+        if table == "objects":
+            return {
                 oid: (e.status, e.inline, e.spilled_path, e.size, e.error)
                 for oid, e in self.objects.items()
                 if e.inline is not None
                 or e.spilled_path is not None
                 or e.status == FAILED
-            },
-        }
+            }
+        raise KeyError(table)
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """All durable tables (tests/full snapshots); caller holds the
+        lock."""
+        return {t: self._snapshot_table(t) for t in self._TABLES}
 
     def _persist_loop(self):
         import pickle as _pickle
@@ -1759,14 +1842,42 @@ class GcsServer:
                 continue
             with self._lock:
                 version = self._version
-                snap = self._snapshot_state()
+                dirty = {
+                    t: v
+                    for t, v in self._table_versions.items()
+                    if v != self._persisted_table_versions[t]
+                }
+                snaps = {t: self._snapshot_table(t) for t in dirty}
             try:
-                blob = _pickle.dumps(snap)
-                tmp = self._state_path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(blob)
-                os.replace(tmp, self._state_path)
+                os.makedirs(self._state_dir, exist_ok=True)
+                # Versioned table files first, manifest swap LAST: a
+                # crash anywhere leaves the previous manifest pointing
+                # at a complete, mutually-consistent file set (one
+                # mutation's multi-table dirt lands in one manifest).
+                for t, payload in snaps.items():
+                    name = f"{t}.{dirty[t]}.pkl"
+                    tmp = os.path.join(self._state_dir, name + ".tmp")
+                    with open(tmp, "wb") as f:
+                        f.write(_pickle.dumps(payload))
+                    os.replace(tmp, os.path.join(self._state_dir, name))
+                    self._manifest[t] = name
+                mtmp = os.path.join(self._state_dir, "manifest.pkl.tmp")
+                with open(mtmp, "wb") as f:
+                    f.write(_pickle.dumps(dict(self._manifest)))
+                os.replace(
+                    mtmp, os.path.join(self._state_dir, "manifest.pkl")
+                )
+                for t, v in dirty.items():
+                    self._persisted_table_versions[t] = v
                 self._persisted_version = version
+                # GC superseded table files.
+                live = set(self._manifest.values()) | {"manifest.pkl"}
+                for f in os.listdir(self._state_dir):
+                    if f not in live and not f.endswith(".tmp"):
+                        try:
+                            os.unlink(os.path.join(self._state_dir, f))
+                        except OSError:
+                            pass
             except FileNotFoundError:
                 return  # session dir removed: shutting down
             except Exception as e:  # noqa: BLE001
@@ -1779,8 +1890,29 @@ class GcsServer:
         method calls) once nodes re-register."""
         import pickle as _pickle
 
-        with open(self._state_path, "rb") as f:
-            snap = _pickle.load(f)
+        manifest_path = os.path.join(self._state_dir, "manifest.pkl")
+        restored_legacy = False
+        if os.path.exists(manifest_path):
+            with open(manifest_path, "rb") as f:
+                manifest = _pickle.load(f)
+            snap = {}
+            for t in self._TABLES:
+                name = manifest.get(t)
+                if name is None:
+                    snap[t] = [] if t == "pending" else {}
+                    continue
+                with open(
+                    os.path.join(self._state_dir, name), "rb"
+                ) as f:
+                    snap[t] = _pickle.load(f)
+        elif os.path.exists(self._state_path):
+            # Legacy single-file snapshot from an older head (or a
+            # crash before the first manifest landed).
+            with open(self._state_path, "rb") as f:
+                snap = _pickle.load(f)
+            restored_legacy = True
+        else:
+            raise FileNotFoundError(self._state_dir)
         self.kv = snap["kv"]
         self.functions = snap["functions"]
         self.named_actors = snap["named_actors"]
@@ -1862,6 +1994,7 @@ class GcsServer:
             f"{len(self._pending)} pending tasks, "
             f"{sum(len(d) for d in self.kv.values())} kv keys\n"
         )
+        return restored_legacy
 
     # ------------------------------------------------------------ log pipeline
 
@@ -2110,6 +2243,7 @@ class GcsServer:
             entry.spilled_path = path
             entry.segment = None
             self._version += 1  # spilled location is durable state
+            self._table_versions["objects"] += 1
         self._store.delete(ObjectID(oid))
         return n
 
@@ -2563,6 +2697,7 @@ class GcsServer:
                 pg.state = "CREATED"
                 self._notify_pg_waiters(pg)
                 self._version += 1
+                self._table_versions["placement_groups"] += 1
                 progressed = True
         requeue: List[TaskSpec] = []
         # Each task that found resources but no worker claims one starting
@@ -2589,6 +2724,9 @@ class GcsServer:
                     else TaskUnschedulableError
                 )
                 self._fail_task_returns(spec, exc_cls(str(e)))
+                self._version += 1  # FAILED returns are durable state
+                for _t in ("objects", "pending", "actors"):
+                    self._table_versions[_t] += 1
                 progressed = True
                 continue
             if node is None:
@@ -2709,6 +2847,10 @@ class GcsServer:
                 else WorkerCrashedError
             )
             self._version += 1  # task failures are durable state
+            for _t in (
+                "objects", "actors", "pending", "orphans", "named_actors",
+            ):
+                self._table_versions[_t] += 1
             prev_state = w.state
             w.state = W_DEAD
             node = self.nodes.get(w.node_id.binary())
