@@ -2129,6 +2129,45 @@ class GcsServer:
                 self._stack_waiters.pop(token, None)
             state["peer"].reply(msg, ok=False, error="worker connection lost")
 
+    def _h_worker_profile(self, state, msg):
+        """Sampling profile from a worker: folded flamegraph stacks
+        over `duration` seconds (reference: reporter/profile_manager.py
+        py-spy capture; statistical, not a single snapshot)."""
+        wid = msg["worker_id"]
+        try:
+            duration = float(msg.get("duration", 5.0))
+        except (TypeError, ValueError):
+            duration = 5.0
+        if not (duration == duration):  # NaN would un-expire the waiter
+            duration = 5.0
+        duration = min(max(duration, 0.1), 60.0)
+        with self._lock:
+            w = self.workers.get(wid)
+            conn = w.conn if w is not None else None
+            if conn is None:
+                state["peer"].reply(
+                    msg, ok=False, error="no such worker (or not connected)"
+                )
+                return
+            token = f"p-{wid.hex()[:8]}-{time.time():.6f}"
+            # Waiter expiry must outlive the sampling window.
+            self._stack_waiters[token] = (
+                state["peer"], msg, time.time() + duration,
+            )
+        try:
+            conn.send(
+                {
+                    "type": "profile_stacks",
+                    "token": token,
+                    "duration": duration,
+                    "interval": float(msg.get("interval", 0.01)),
+                }
+            )
+        except ConnectionLost:
+            with self._lock:
+                self._stack_waiters.pop(token, None)
+            state["peer"].reply(msg, ok=False, error="worker connection lost")
+
     def _h_stack_dump(self, state, msg):
         with self._lock:
             waiter = self._stack_waiters.pop(msg.get("token"), None)
@@ -2136,7 +2175,10 @@ class GcsServer:
             return
         peer, orig, _ = waiter
         try:
-            peer.reply(orig, ok=True, text=msg.get("text", ""))
+            peer.reply(
+                orig, ok=True, text=msg.get("text", ""),
+                samples=msg.get("samples"),
+            )
         except ConnectionLost:
             pass
 

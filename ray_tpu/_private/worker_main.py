@@ -22,6 +22,18 @@ import sys
 import threading
 import time
 import traceback
+
+
+def _finite(value, default: float, cap: float, floor: float = 0.0) -> float:
+    """Clamp an untrusted numeric knob to [floor, cap]; NaN/garbage
+    falls back to the default (profiling knobs arrive from HTTP)."""
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return default
+    if v != v:  # NaN
+        return default
+    return min(max(v, floor), cap)
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
@@ -837,6 +849,26 @@ def main():
 
     def push(msg):
         t = msg["type"]
+        def _send_stack_reply(token, text, **extra):
+            # A dump can race CoreClient construction (the GCS learns
+            # of this worker during the handshake); wait briefly for
+            # main() to publish the client.
+            deadline = time.monotonic() + 2.0
+            while (
+                "boot_client" not in rt_holder
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            try:
+                rt_holder["boot_client"].send(
+                    {
+                        "type": "stack_dump", "token": token,
+                        "text": text, **extra,
+                    }
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
         if t == "execute_task":
             task_queue.put((msg["spec"], None))
         elif t == "dump_stacks":
@@ -854,25 +886,52 @@ def main():
                     f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
                     + "".join(_tb.format_stack(frame))
                 )
-            # A dump can race CoreClient construction (the GCS learns of
-            # this worker during the handshake); wait briefly on the
-            # reader thread for main() to publish the client.
-            deadline = time.monotonic() + 2.0
-            while (
-                "boot_client" not in rt_holder
-                and time.monotonic() < deadline
-            ):
-                time.sleep(0.01)
-            try:
-                rt_holder["boot_client"].send(
-                    {
-                        "type": "stack_dump",
-                        "token": msg.get("token"),
-                        "text": "".join(parts),
-                    }
+            _send_stack_reply(msg.get("token"), "".join(parts))
+        elif t == "profile_stacks":
+            # Statistical sampling profile (reference: the dashboard's
+            # py-spy -f flamegraph capture — here in-process, no
+            # ptrace): sample every thread's stack for `duration`
+            # seconds on a dedicated thread and reply with collapsed
+            # folded-stack lines ("a;b;c <count>"), the standard
+            # flamegraph/speedscope input format.
+            def _sample(token=msg.get("token"),
+                        duration=_finite(msg.get("duration"), 5.0, 60.0),
+                        interval=_finite(
+                            msg.get("interval"), 0.01, 1.0, floor=0.001
+                        )):
+                me = threading.get_ident()
+                counts: dict = {}
+                t_end = time.monotonic() + duration
+                n_samples = 0
+                while time.monotonic() < t_end:
+                    for tid, frame in sys._current_frames().items():
+                        if tid == me:
+                            continue
+                        stack = []
+                        f = frame
+                        while f is not None:
+                            c = f.f_code
+                            stack.append(
+                                f"{c.co_name} "
+                                f"({os.path.basename(c.co_filename)}"
+                                f":{f.f_lineno})"
+                            )
+                            f = f.f_back
+                        key = ";".join(reversed(stack))
+                        counts[key] = counts.get(key, 0) + 1
+                    n_samples += 1
+                    time.sleep(interval)
+                folded = "\n".join(
+                    f"{k} {v}"
+                    for k, v in sorted(
+                        counts.items(), key=lambda kv: -kv[1]
+                    )
                 )
-            except Exception:  # noqa: BLE001
-                pass
+                _send_stack_reply(token, folded, samples=n_samples)
+
+            threading.Thread(
+                target=_sample, name="profile-sampler", daemon=True
+            ).start()
         elif t == "exit":
             task_queue.put((None, None))
 

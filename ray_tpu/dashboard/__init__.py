@@ -10,9 +10,12 @@ same data pipelines:
   /api/{nodes,workers,...}  state API as JSON
   /api/metrics_timeseries   ring buffer of sampled core gauges
   /api/logs?prefix=&tail=   the driver log ring (log pipeline)
-  /api/profile/{worker_id}  live thread stacks from a worker
-                            (reference: reporter/profile_manager.py —
-                            sys._current_frames instead of py-spy)
+  /api/profile/{worker_id}  live thread stacks from a worker;
+                            ?mode=sample&duration=5 returns a
+                            statistical profile as folded flamegraph
+                            stacks (reference:
+                            reporter/profile_manager.py py-spy -f —
+                            in-process sampling instead of ptrace)
   /metrics                  Prometheus text exposition of user +
                             core-runtime metrics (reference: the node
                             metrics agent's Prometheus endpoint)
@@ -307,6 +310,39 @@ class DashboardActor:
         from .._private.worker import global_client
 
         wid = bytes.fromhex(request.match_info["worker_id"])
+        if request.query.get("mode") == "sample":
+            # Statistical profile: folded flamegraph stacks, ready for
+            # speedscope / flamegraph.pl.
+            try:
+                duration = float(request.query.get("duration", "5"))
+            except ValueError:
+                duration = 5.0
+            if not (duration == duration):  # NaN
+                duration = 5.0
+            duration = min(max(duration, 0.1), 60.0)
+            reply = await asyncio.to_thread(
+                global_client().request,
+                {
+                    "type": "worker_profile",
+                    "worker_id": wid,
+                    "duration": duration,
+                    "interval": float(
+                        request.query.get("interval", "0.01")
+                    ),
+                },
+                duration + 15.0,
+            )
+            if not reply.get("ok"):
+                return web.Response(
+                    status=404, text=reply.get("error", "?")
+                )
+            header = (
+                f"# folded stacks: {reply.get('samples')} samples over "
+                f"{duration}s\n"
+            )
+            return web.Response(
+                text=header + reply["text"], content_type="text/plain"
+            )
         # The GCS waiter can take up to its 10s sweep to time out —
         # never hold the event loop for that.
         reply = await asyncio.to_thread(

@@ -152,3 +152,54 @@ def test_worker_stacks_unknown_worker(cluster):
         {"type": "worker_stacks", "worker_id": b"\x00" * 16}, timeout=10.0
     )
     assert not reply.get("ok")
+
+
+def test_sampling_profile_folded_stacks(cluster):
+    """?mode=sample returns a statistical profile in folded-flamegraph
+    format with the busy function dominating (reference:
+    profile_manager.py py-spy -f capture)."""
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util.state import list_workers
+
+    @ray_tpu.remote
+    class Spinner:
+        def pid(self):
+            import os as _os
+
+            return _os.getpid()
+
+        def spin_hot_loop_marker(self, seconds):
+            import time as _t
+
+            t_end = _t.monotonic() + seconds
+            x = 0
+            while _t.monotonic() < t_end:
+                x += 1
+            return x
+
+    s = Spinner.remote()
+    target_pid = ray_tpu.get(s.pid.remote())
+    ref = s.spin_hot_loop_marker.remote(8.0)
+
+    url = start_dashboard(port=18273)
+    # Select the spinner's worker by pid: other actors (the dashboard
+    # itself) are also "is_actor" workers.
+    wid = next(
+        w["worker_id"]
+        for w in list_workers(limit=100)
+        if w["pid"] == target_pid
+    )
+    with urllib.request.urlopen(
+        f"{url}/api/profile/{wid}?mode=sample&duration=2", timeout=30
+    ) as r:
+        folded = r.read().decode()
+    assert folded.startswith("# folded stacks:")
+    lines = [l for l in folded.splitlines()[1:] if l.strip()]
+    assert lines, folded
+    # Every line is "stack;frames count".
+    for line in lines[:5]:
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit(), line
+    # The hot loop dominates the samples.
+    assert "spin_hot_loop_marker" in folded
+    ray_tpu.get(ref, timeout=60)
