@@ -1,0 +1,819 @@
+"""DreamerV3: world-model RL — learn in imagination.
+
+Reference: rllib/algorithms/dreamerv3/ (tf world model + imagination
+actor-critic). TPU-first re-design: the entire training step — RSSM
+sequence rollout (lax.scan), all world-model heads, the imagined
+actor-critic rollout (a second scan) and three optimizers' gradients —
+compiles into ONE jitted XLA program via combined losses with
+stop-gradient partitions and per-group learning rates
+(optax.multi_transform); nothing leaves the device between the
+posterior scan and the parameter update.
+
+The v3 signatures are kept: categorical latents (groups x classes)
+with 1% unimix and straight-through gradients, symlog regression for
+observations, twohot symexp bins for reward and value, free-bits KL
+with the 0.1 representation-loss weighting, lambda-returns on imagined
+trajectories, percentile-EMA return normalization, and an
+EMA-regularized slow critic.
+
+Like the reference, DreamerV3 does not use the shared env-runner
+machinery: acting is recurrent (the RSSM state threads through the
+episode), so the algorithm owns its vectorized collection loop and a
+sequence-replay buffer of whole episodes.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .algorithm import Algorithm
+from .algorithm_config import AlgorithmConfig
+
+
+def _symlog(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def _symexp(x):
+    import jax.numpy as jnp
+
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+class _TwoHot:
+    """Twohot encoding over symlog-spaced bins (reference:
+    dreamerv3/utils/two_hot.py): scalars become a categorical CE
+    target, killing reward/value scale sensitivity."""
+
+    def __init__(self, n_bins: int = 41, low: float = -20.0,
+                 high: float = 20.0):
+        import jax.numpy as jnp
+
+        self.bins = jnp.linspace(low, high, n_bins)
+        self.n = n_bins
+
+    def encode(self, x):
+        import jax.numpy as jnp
+
+        x = jnp.clip(_symlog(x), self.bins[0], self.bins[-1])
+        idx = jnp.clip(
+            jnp.searchsorted(self.bins, x, side="right") - 1, 0, self.n - 2
+        )
+        lo, hi = self.bins[idx], self.bins[idx + 1]
+        w_hi = (x - lo) / (hi - lo)
+        # Scatter via one_hot (vectorized, no advanced indexing).
+        import jax
+
+        oh_lo = jax.nn.one_hot(idx, self.n) * (1.0 - w_hi)[..., None]
+        oh_hi = jax.nn.one_hot(idx + 1, self.n) * w_hi[..., None]
+        return oh_lo + oh_hi
+
+    def decode(self, logits):
+        import jax
+        import jax.numpy as jnp
+
+        probs = jax.nn.softmax(logits, axis=-1)
+        return _symexp(jnp.sum(probs * self.bins, axis=-1))
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        # World model (tiny-by-default: CI trains on CPU; scale via
+        # model_config for real runs).
+        self.model_config = {
+            "deter": 256,
+            "stoch_groups": 8,
+            "stoch_classes": 8,
+            "units": 256,
+            "bins": 41,
+        }
+        self.lr = 1e-4  # world model
+        self.actor_lr = 3e-5
+        self.critic_lr = 3e-5
+        self.grad_clip = 100.0
+        self.gamma = 0.997
+        self.gae_lambda = 0.95
+        self.horizon = 15
+        self.entropy_coef = 3e-4
+        self.free_bits = 1.0
+        self.rep_loss_scale = 0.1
+        self.dyn_loss_scale = 0.5
+        self.critic_ema_decay = 0.98
+        self.critic_ema_reg = 1.0
+        self.batch_size_B = 8
+        self.batch_length_T = 32
+        self.train_ratio = 64.0  # replayed steps per env step
+        self.num_envs = 4
+        self.sample_timesteps_per_iteration = 400
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.replay_capacity_steps = 100_000
+
+    @property
+    def algo_class(self):
+        return DreamerV3
+
+
+class _EpisodeReplay:
+    """Stores whole episodes; samples (B, T) subsequences with
+    `is_first` flags (reference: dreamerv3 EpisodeReplayBuffer)."""
+
+    def __init__(self, capacity_steps: int, seed=None):
+        self.capacity = capacity_steps
+        self._eps: List[Dict[str, np.ndarray]] = []
+        self._steps = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, obs, actions, rewards, terminateds):
+        ep = {
+            "obs": np.asarray(obs, np.float32),  # T+1 observations
+            "actions": np.asarray(actions, np.int64),  # T
+            "rewards": np.asarray(rewards, np.float32),  # T
+            "terminated": bool(terminateds),
+        }
+        self._eps.append(ep)
+        self._steps += len(ep["actions"])
+        while self._steps > self.capacity and len(self._eps) > 1:
+            old = self._eps.pop(0)
+            self._steps -= len(old["actions"])
+
+    def __len__(self):
+        return self._steps
+
+    def sample(self, B: int, T: int) -> Dict[str, np.ndarray]:
+        """Each row: T steps; crossing an episode start sets is_first.
+        Short episodes are padded by wrapping into another episode
+        (standard dreamer replay semantics: the RSSM resets at
+        is_first, so stitching is sound)."""
+        obs_dim = self._eps[0]["obs"].shape[-1]
+        out = {
+            "obs": np.zeros((B, T, obs_dim), np.float32),
+            "actions": np.zeros((B, T), np.int64),
+            "rewards": np.zeros((B, T), np.float32),
+            "continues": np.ones((B, T), np.float32),
+            "is_first": np.zeros((B, T), np.float32),
+        }
+        for b in range(B):
+            t = 0
+            while t < T:
+                ep = self._eps[self._rng.integers(len(self._eps))]
+                n = len(ep["actions"])
+                start = int(self._rng.integers(n)) if t == 0 else 0
+                take = min(T - t, n - start)
+                sl = slice(start, start + take)
+                out["obs"][b, t : t + take] = ep["obs"][:-1][sl]
+                out["actions"][b, t : t + take] = ep["actions"][sl]
+                out["rewards"][b, t : t + take] = ep["rewards"][sl]
+                if ep["terminated"] and start + take == n:
+                    out["continues"][b, t + take - 1] = 0.0
+                out["is_first"][b, t] = 1.0 if t == 0 or start == 0 else 0.0
+                t += take
+        return out
+
+
+class DreamerV3(Algorithm):
+    """Owns collection (recurrent acting), replay, and the one-program
+    learner update."""
+
+    learner_class = None  # self-contained: no shared Learner machinery
+
+    def setup(self, config_dict) -> None:
+        import gymnasium as gym
+        import jax
+
+        # Same config unpacking as Algorithm.setup, WITHOUT the shared
+        # env-runner/learner groups (recurrent acting owns its loop).
+        self.config = config_dict["__algorithm_config__"].copy()
+        for k, v in config_dict.items():
+            if k != "__algorithm_config__" and hasattr(self.config, k):
+                setattr(self.config, k, v)
+        cfg = self.config
+        self._rng_key = jax.random.PRNGKey(cfg.seed or 0)
+        env_spec = cfg.env
+        self._envs = [
+            (env_spec() if callable(env_spec) else gym.make(env_spec))
+            for _ in range(cfg.num_envs)
+        ]
+        obs_space = self._envs[0].observation_space
+        act_space = self._envs[0].action_space
+        self._obs_dim = int(np.prod(obs_space.shape))
+        self._n_actions = int(act_space.n)
+        self.replay = _EpisodeReplay(cfg.replay_capacity_steps, cfg.seed)
+        self._build_nets()
+        self._build_update()
+        # Per-env recurrent state + open episode accumulators.
+        self._reset_collection()
+        self._total_env_steps = 0
+        self._updates = 0
+        self._ep_returns: List[float] = []
+        self._np_rng = np.random.default_rng(cfg.seed)
+
+    # ------------------------------------------------------------ networks
+    def _build_nets(self):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        mc = self.config.model_config
+        D, G, C, U = (
+            mc["deter"], mc["stoch_groups"], mc["stoch_classes"],
+            mc["units"],
+        )
+        self._G, self._C, self._D = G, C, D
+        self.twohot = _TwoHot(mc["bins"])
+        n_act = self._n_actions
+        obs_dim = self._obs_dim
+
+        class Nets(nn.Module):
+            @nn.compact
+            def __call__(self, mode, *args):
+                return getattr(self, mode)(*args)
+
+            def _mlp(self, x, out, name, layers=2):
+                for i in range(layers):
+                    x = nn.silu(
+                        nn.LayerNorm(name=f"{name}_ln{i}")(
+                            nn.Dense(U, name=f"{name}_d{i}")(x)
+                        )
+                    )
+                return nn.Dense(
+                    out,
+                    name=f"{name}_out",
+                    kernel_init=nn.initializers.variance_scaling(
+                        0.1, "fan_in", "truncated_normal"
+                    ),
+                )(x)
+
+            def encode(self, obs):
+                return self._mlp(_symlog(obs), U, "enc")
+
+            def seq(self, deter, stoch, action):
+                x = jnp.concatenate(
+                    [stoch.reshape(stoch.shape[0], G * C),
+                     jax.nn.one_hot(action, n_act)],
+                    -1,
+                )
+                x = nn.silu(
+                    nn.LayerNorm(name="gru_in_ln")(
+                        nn.Dense(U, name="gru_in")(x)
+                    )
+                )
+                new_deter, _ = nn.GRUCell(D, name="gru")(deter, x)
+                return new_deter
+
+            def prior(self, deter):
+                return self._mlp(deter, G * C, "prior").reshape(
+                    (-1, G, C)
+                )
+
+            def posterior(self, deter, embed):
+                x = jnp.concatenate([deter, embed], -1)
+                return self._mlp(x, G * C, "post").reshape((-1, G, C))
+
+            def decode(self, deter, stoch):
+                x = jnp.concatenate(
+                    [deter, stoch.reshape(stoch.shape[0], G * C)], -1
+                )
+                return self._mlp(x, obs_dim, "dec")
+
+            def reward(self, deter, stoch):
+                x = jnp.concatenate(
+                    [deter, stoch.reshape(stoch.shape[0], G * C)], -1
+                )
+                return self._mlp(x, mc["bins"], "rew")
+
+            def cont(self, deter, stoch):
+                x = jnp.concatenate(
+                    [deter, stoch.reshape(stoch.shape[0], G * C)], -1
+                )
+                return self._mlp(x, 1, "cont")[..., 0]
+
+            def actor(self, deter, stoch):
+                x = jnp.concatenate(
+                    [deter, stoch.reshape(stoch.shape[0], G * C)], -1
+                )
+                return self._mlp(x, n_act, "actor")
+
+            def critic(self, deter, stoch):
+                x = jnp.concatenate(
+                    [deter, stoch.reshape(stoch.shape[0], G * C)], -1
+                )
+                return self._mlp(x, mc["bins"], "critic")
+
+        self.nets = Nets()
+        import jax
+
+        self._rng_key, k = jax.random.split(self._rng_key)
+        obs0 = jnp.zeros((1, obs_dim))
+        deter0 = jnp.zeros((1, D))
+        stoch0 = jnp.zeros((1, G, C))
+        params = self.nets.init(k, "encode", obs0)
+
+        # Materialize every head's params once (deterministic per-mode
+        # fold_in indices: seeded runs must reproduce).
+        p = params
+        for i, (mode, args) in enumerate(
+            (
+                ("seq", (deter0, stoch0, jnp.zeros((1,), jnp.int32))),
+                ("prior", (deter0,)),
+                ("posterior", (deter0, jnp.zeros((1, U)))),
+                ("decode", (deter0, stoch0)),
+                ("reward", (deter0, stoch0)),
+                ("cont", (deter0, stoch0)),
+                ("actor", (deter0, stoch0)),
+                ("critic", (deter0, stoch0)),
+            )
+        ):
+            out = self.nets.init(jax.random.fold_in(k, i + 1), mode, *args)
+            p = {"params": {**p["params"], **out["params"]}}
+        self.params = p
+        self.slow_critic = jax.tree_util.tree_map(
+            lambda x: x, self.params
+        )
+        # Return-normalization percentile EMA.
+        self._ret_lo = 0.0
+        self._ret_hi = 1.0
+
+    # ----------------------------------------------------------- update fn
+    def _build_update(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        cfg = self.config
+        G, C = self._G, self._C
+        twohot = self.twohot
+        nets = self.nets
+        WM_PREFIXES = (
+            "enc", "gru", "prior", "post", "dec", "rew", "cont",
+        )
+
+        def group_of(path_key: str) -> str:
+            for pre in WM_PREFIXES:
+                if path_key.startswith(pre):
+                    return "wm"
+            return "actor" if path_key.startswith("actor") else "critic"
+
+        def label_tree(params):
+            return {
+                "params": {
+                    k: group_of(k) for k in params["params"]
+                }
+            }
+
+        tx = optax.multi_transform(
+            {
+                "wm": optax.chain(
+                    optax.clip_by_global_norm(cfg.grad_clip),
+                    optax.adam(cfg.lr),
+                ),
+                "actor": optax.chain(
+                    optax.clip_by_global_norm(cfg.grad_clip),
+                    optax.adam(cfg.actor_lr),
+                ),
+                "critic": optax.chain(
+                    optax.clip_by_global_norm(cfg.grad_clip),
+                    optax.adam(cfg.critic_lr),
+                ),
+            },
+            label_tree(self.params),
+        )
+        self._tx = tx
+        self.opt_state = tx.init(self.params)
+
+        def unimix_sample(logits, key):
+            probs = jax.nn.softmax(logits, -1)
+            probs = 0.99 * probs + 0.01 / C  # 1% unimix
+            logp = jnp.log(probs)
+            idx = jax.random.categorical(key, logp, axis=-1)
+            hot = jax.nn.one_hot(idx, C)
+            # Straight-through gradients to the logits.
+            return hot + probs - jax.lax.stop_gradient(probs), logp
+
+        def kl_cat(logp_a, logp_b):
+            pa = jnp.exp(logp_a)
+            return jnp.sum(pa * (logp_a - logp_b), axis=(-2, -1))
+
+        def loss_fn(params, slow_critic, batch, key, ret_lo, ret_hi):
+            obs = batch["obs"]  # [B, T, obs]
+            B, T = obs.shape[:2]
+            acts = batch["actions"]
+            is_first = batch["is_first"]
+
+            embed = nets.apply(
+                params, "encode", obs.reshape(B * T, -1)
+            ).reshape(B, T, -1)
+
+            def step(carry, inp):
+                deter, stoch, k = carry
+                emb_t, act_prev, first_t = inp
+                k, k1 = jax.random.split(k)
+                # Episode boundary: reset state (v3 resets to zeros).
+                deter = deter * (1.0 - first_t)[:, None]
+                stoch = stoch * (1.0 - first_t)[:, None, None]
+                act_prev = (act_prev * (1.0 - first_t)).astype(jnp.int32)
+                deter = nets.apply(params, "seq", deter, stoch, act_prev)
+                prior_logits = nets.apply(params, "prior", deter)
+                post_logits = nets.apply(
+                    params, "posterior", deter, emb_t
+                )
+                stoch, _ = unimix_sample(post_logits, k1)
+                return (deter, stoch, k), (
+                    deter, stoch, prior_logits, post_logits
+                )
+
+            deter0 = jnp.zeros((B, self._D))
+            stoch0 = jnp.zeros((B, G, C))
+            act_prev = jnp.concatenate(
+                [jnp.zeros((B, 1), acts.dtype), acts[:, :-1]], 1
+            )
+            key, kscan = jax.random.split(key)
+            (_, _, _), (deters, stochs, priors, posts) = jax.lax.scan(
+                step,
+                (deter0, stoch0, kscan),
+                (
+                    embed.transpose(1, 0, 2),
+                    act_prev.T,
+                    is_first.T,
+                ),
+            )
+            # [T, B, ...] -> flat [T*B, ...]
+            TB = T * B
+            deters_f = deters.reshape(TB, -1)
+            stochs_f = stochs.reshape(TB, G, C)
+
+            # ---- world-model losses
+            dec = nets.apply(params, "decode", deters_f, stochs_f)
+            obs_t = _symlog(obs.transpose(1, 0, 2).reshape(TB, -1))
+            recon_loss = jnp.mean(jnp.sum((dec - obs_t) ** 2, -1))
+            rew_logits = nets.apply(params, "reward", deters_f, stochs_f)
+            rew_target = twohot.encode(
+                batch["rewards"].T.reshape(TB)
+            )
+            reward_loss = -jnp.mean(
+                jnp.sum(
+                    rew_target
+                    * jax.nn.log_softmax(rew_logits, -1),
+                    -1,
+                )
+            )
+            cont_logits = nets.apply(params, "cont", deters_f, stochs_f)
+            cont_target = batch["continues"].T.reshape(TB)
+            cont_loss = jnp.mean(
+                optax.sigmoid_binary_cross_entropy(
+                    cont_logits, cont_target
+                )
+            )
+
+            def logp_unimix(logits):
+                p = jax.nn.softmax(logits, -1)
+                return jnp.log(0.99 * p + 0.01 / C)
+
+            lp_post = logp_unimix(posts.reshape(TB, G, C))
+            lp_prior = logp_unimix(priors.reshape(TB, G, C))
+            sg = jax.lax.stop_gradient
+            dyn = jnp.maximum(
+                kl_cat(sg(lp_post), lp_prior), cfg.free_bits
+            ).mean()
+            rep = jnp.maximum(
+                kl_cat(lp_post, sg(lp_prior)), cfg.free_bits
+            ).mean()
+            wm_loss = (
+                recon_loss
+                + reward_loss
+                + cont_loss
+                + cfg.dyn_loss_scale * dyn
+                + cfg.rep_loss_scale * rep
+            )
+
+            # ---- imagination rollout from (sg) posterior states
+            H = cfg.horizon
+            img_deter = sg(deters_f)
+            img_stoch = sg(stochs_f)
+
+            # Frozen world model for behavior learning: actor/critic
+            # gradients must not leak into the dynamics.
+            pf = jax.tree_util.tree_map(sg, params)
+
+            def img_step(carry, _):
+                deter, stoch, k = carry
+                k, k1, k2 = jax.random.split(k, 3)
+                a_logits = nets.apply(params, "actor", deter, stoch)
+                act = jax.random.categorical(k1, a_logits)
+                new_deter = nets.apply(pf, "seq", deter, stoch, act)
+                prior_logits = nets.apply(pf, "prior", new_deter)
+                new_stoch, _ = unimix_sample(prior_logits, k2)
+                return (new_deter, new_stoch, k), (
+                    deter, stoch, act, a_logits
+                )
+
+            key, kimg = jax.random.split(key)
+            (last_deter, last_stoch, _), (
+                tr_deter, tr_stoch, tr_act, tr_logits
+            ) = jax.lax.scan(
+                img_step, (img_deter, img_stoch, kimg), None, length=H
+            )
+            # Heads over the imagined trajectory (+ bootstrap state).
+            all_deter = jnp.concatenate(
+                [tr_deter, last_deter[None]], 0
+            ).reshape((H + 1) * TB, -1)
+            all_stoch = jnp.concatenate(
+                [tr_stoch, last_stoch[None]], 0
+            ).reshape((H + 1) * TB, G, C)
+            rew = twohot.decode(
+                nets.apply(pf, "reward", all_deter, all_stoch)
+            ).reshape(H + 1, TB)
+            cont = jax.nn.sigmoid(
+                nets.apply(pf, "cont", all_deter, all_stoch)
+            ).reshape(H + 1, TB)
+            val_logits = nets.apply(params, "critic", all_deter, all_stoch)
+            values = twohot.decode(val_logits).reshape(H + 1, TB)
+            slow_vals = twohot.decode(
+                nets.apply(
+                    slow_critic, "critic", sg(all_deter), sg(all_stoch)
+                )
+            ).reshape(H + 1, TB)
+
+            disc = cfg.gamma * cont
+            # Lambda returns, backwards.
+            def lam_step(nxt, t):
+                r_t = rew[t + 1]
+                d_t = disc[t + 1]
+                v_next = values[t + 1]
+                ret = r_t + d_t * (
+                    (1 - cfg.gae_lambda) * sg(v_next)
+                    + cfg.gae_lambda * nxt
+                )
+                return ret, ret
+
+            last = sg(values[H])
+            _, rets = jax.lax.scan(
+                lam_step, last, jnp.arange(H - 1, -1, -1)
+            )
+            returns = rets[::-1]  # [H, TB], target for values[0..H-1]
+            returns = sg(returns)
+
+            # Return normalization: percentile EMA scale.
+            scale = jnp.maximum(ret_hi - ret_lo, 1.0)
+            base_vals = values[:H]
+            adv = (returns - base_vals) / scale
+
+            a_logp_all = jax.nn.log_softmax(
+                tr_logits.reshape(H * TB, -1), -1
+            )
+            act_logp = jnp.take_along_axis(
+                a_logp_all, tr_act.reshape(H * TB, 1), 1
+            )[:, 0].reshape(H, TB)
+            entropy = -jnp.sum(
+                jnp.exp(a_logp_all) * a_logp_all, -1
+            ).reshape(H, TB)
+            # Weight by in-horizon continuation probability.
+            live = jnp.concatenate(
+                [jnp.ones((1, TB)), jnp.cumprod(cont[:H], 0)[:-1]], 0
+            )
+            actor_loss = -jnp.mean(
+                live * (sg(adv) * act_logp + cfg.entropy_coef * entropy)
+            )
+
+            # Critic: twohot CE to lambda returns + slow-critic reg.
+            v_logits = val_logits.reshape(H + 1, TB, -1)[:H]
+            ret_target = twohot.encode(returns)
+            critic_ce = -jnp.sum(
+                ret_target * jax.nn.log_softmax(v_logits, -1), -1
+            )
+            slow_target = twohot.encode(sg(slow_vals[:H]))
+            critic_reg = -jnp.sum(
+                slow_target * jax.nn.log_softmax(v_logits, -1), -1
+            )
+            critic_loss = jnp.mean(
+                live * (critic_ce + cfg.critic_ema_reg * critic_reg)
+            )
+
+            total = wm_loss + actor_loss + critic_loss
+            metrics = {
+                "wm_loss": wm_loss,
+                "recon_loss": recon_loss,
+                "reward_loss": reward_loss,
+                "cont_loss": cont_loss,
+                "kl_dyn": dyn,
+                "actor_loss": actor_loss,
+                "critic_loss": critic_loss,
+                "entropy": jnp.mean(entropy),
+                "imagined_return_mean": jnp.mean(returns),
+                "ret_p5": jnp.percentile(returns, 5.0),
+                "ret_p95": jnp.percentile(returns, 95.0),
+            }
+            return total, metrics
+
+        @jax.jit
+        def update(params, slow_critic, opt_state, batch, key, lo, hi):
+            (_, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, slow_critic, batch, key, lo, hi)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            d = cfg.critic_ema_decay
+            slow_critic = jax.tree_util.tree_map(
+                lambda s, p: d * s + (1 - d) * p, slow_critic, params
+            )
+            return params, slow_critic, opt_state, metrics
+
+        self._update = update
+
+        @jax.jit
+        def act(params, deter, stoch, obs, prev_action, first, key):
+            k1, k2 = jax.random.split(key)
+            B = obs.shape[0]
+            deter = deter * (1.0 - first)[:, None]
+            stoch = stoch * (1.0 - first)[:, None, None]
+            prev_action = (prev_action * (1.0 - first)).astype(jnp.int32)
+            deter = nets.apply(params, "seq", deter, stoch, prev_action)
+            emb = nets.apply(params, "encode", obs)
+            post = nets.apply(params, "posterior", deter, emb)
+            stoch, _ = unimix_sample(post, k1)
+            logits = nets.apply(params, "actor", deter, stoch)
+            action = jax.random.categorical(k2, logits)
+            return deter, stoch, action
+
+        self._act = act
+
+    # ---------------------------------------------------------- collection
+    def _reset_collection(self):
+        n = self.config.num_envs
+        self._deter = np.zeros((n, self._D), np.float32)
+        self._stoch = np.zeros((n, self._G, self._C), np.float32)
+        self._prev_action = np.zeros((n,), np.int64)
+        self._first = np.ones((n,), np.float32)
+        self._cur_obs = []
+        self._acc = []
+        for i, env in enumerate(self._envs):
+            obs, _ = env.reset(seed=(self.config.seed or 0) + i)
+            self._cur_obs.append(np.asarray(obs, np.float32))
+            self._acc.append(
+                {"obs": [self._cur_obs[i]], "actions": [], "rewards": []}
+            )
+
+    def _collect(self, n_steps: int):
+        import jax
+
+        cfg = self.config
+        steps = 0
+        while steps < n_steps:
+            self._rng_key, k = jax.random.split(self._rng_key)
+            obs = np.stack(self._cur_obs)
+            deter, stoch, action = self._act(
+                self.params,
+                self._deter,
+                self._stoch,
+                obs,
+                self._prev_action,
+                self._first,
+                k,
+            )
+            self._deter = np.asarray(deter)
+            self._stoch = np.asarray(stoch)
+            actions = np.asarray(action)
+            self._first = np.zeros_like(self._first)
+            for i, env in enumerate(self._envs):
+                o, r, term, trunc, _ = env.step(int(actions[i]))
+                acc = self._acc[i]
+                acc["actions"].append(int(actions[i]))
+                acc["rewards"].append(float(r))
+                acc["obs"].append(np.asarray(o, np.float32))
+                steps += 1
+                self._total_env_steps += 1
+                if term or trunc:
+                    self.replay.add(
+                        acc["obs"], acc["actions"], acc["rewards"], term
+                    )
+                    self._ep_returns.append(float(np.sum(acc["rewards"])))
+                    o, _ = self._envs[i].reset()
+                    self._acc[i] = {
+                        "obs": [np.asarray(o, np.float32)],
+                        "actions": [],
+                        "rewards": [],
+                    }
+                    self._first[i] = 1.0
+                self._cur_obs[i] = np.asarray(o, np.float32)
+            self._prev_action = actions
+
+    # ------------------------------------------------------------ training
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        self._collect(cfg.sample_timesteps_per_iteration)
+        if len(self.replay) < cfg.num_steps_sampled_before_learning_starts:
+            return {"buffer_steps": float(len(self.replay))}
+        n_updates = max(
+            1,
+            int(
+                cfg.sample_timesteps_per_iteration
+                * cfg.train_ratio
+                / (cfg.batch_size_B * cfg.batch_length_T)
+            ),
+        )
+        metrics_list = []
+        for _ in range(n_updates):
+            batch = self.replay.sample(
+                cfg.batch_size_B, cfg.batch_length_T
+            )
+            self._rng_key, k = jax.random.split(self._rng_key)
+            self.params, self.slow_critic, self.opt_state, m = (
+                self._update(
+                    self.params,
+                    self.slow_critic,
+                    self.opt_state,
+                    batch,
+                    k,
+                    self._ret_lo,
+                    self._ret_hi,
+                )
+            )
+            self._updates += 1
+            m = {k2: float(v) for k2, v in m.items()}
+            # Percentile EMA of imagined returns (v3 return norm).
+            self._ret_lo = 0.99 * self._ret_lo + 0.01 * m.pop("ret_p5")
+            self._ret_hi = 0.99 * self._ret_hi + 0.01 * m.pop("ret_p95")
+            metrics_list.append(m)
+        out = {
+            k2: float(np.mean([m[k2] for m in metrics_list]))
+            for k2 in metrics_list[0]
+        }
+        out["buffer_steps"] = float(len(self.replay))
+        out["num_updates"] = float(self._updates)
+        return out
+
+    def step(self) -> Dict[str, Any]:
+        # Self-contained metrics (no shared env-runner group).
+        learner_metrics = self.training_step()
+        self._iteration = getattr(self, "_iteration", 0) + 1
+        recent = self._ep_returns[-100:]
+        return {
+            "training_iteration": self._iteration,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "episode_return_mean": (
+                float(np.mean(recent)) if recent else float("nan")
+            ),
+            "learners": learner_metrics,
+        }
+
+    def train(self) -> Dict[str, Any]:
+        return self.step()
+
+    def evaluate(self, num_episodes: int = 10) -> Dict[str, Any]:
+        recent = self._ep_returns[-num_episodes:]
+        return {
+            "episode_return_mean": (
+                float(np.mean(recent)) if recent else float("nan")
+            ),
+            "num_episodes": len(recent),
+        }
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        import os
+        import pickle
+
+        import jax
+
+        state = {
+            "params": jax.device_get(self.params),
+            "slow_critic": jax.device_get(self.slow_critic),
+            "opt_state": jax.device_get(self.opt_state),
+            "ret_lo": self._ret_lo,
+            "ret_hi": self._ret_hi,
+            "iteration": getattr(self, "_iteration", 0),
+            "total_env_steps": self._total_env_steps,
+            "updates": self._updates,
+        }
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        with open(
+            os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb"
+        ) as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.slow_critic = state["slow_critic"]
+        self.opt_state = state["opt_state"]
+        self._ret_lo = state["ret_lo"]
+        self._ret_hi = state["ret_hi"]
+        self._iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self._updates = state["updates"]
+
+    save = save_checkpoint
+    restore = load_checkpoint
+
+    def stop(self) -> None:
+        for env in self._envs:
+            env.close()

@@ -37,6 +37,7 @@ _ALGO_MODULES = {
     "CQL": "cql",
     "MARWIL": "marwil",
     "BC": "marwil",
+    "DreamerV3": "dreamerv3",
 }
 
 EXAMPLES_DIR = os.path.dirname(__file__)
