@@ -1,11 +1,14 @@
 """Dashboard: HTTP view of cluster state, metrics, logs, profiling.
 
 Reference: dashboard/ (aiohttp head process serving a React frontend +
-JSON APIs fed by the GCS and agents). Scoped-down equivalent riding the
-same data pipelines:
+JSON APIs fed by the GCS and agents). Equivalent riding the same data
+pipelines, with the frontend as a no-build-step static SPA
+(``client/``: hash-routed pages for overview/nodes/workers/actors/
+tasks/PGs/objects/jobs/serve/logs plus an SVG flamegraph viewer —
+reference ``dashboard/client/src``, matched in function not pixels):
 
-  /                         self-contained HTML overview (tables +
-                            metric sparklines + log tail, no JS deps)
+  /                         the SPA shell (client/index.html)
+  /static/{app.js,style.css} SPA assets
   /api/cluster              resources
   /api/{nodes,workers,...}  state API as JSON
   /api/metrics_timeseries   ring buffer of sampled core gauges
@@ -27,114 +30,21 @@ same data pipelines:
                             workflow/http_event_provider.py)
   /api/task/{task_id}       one task's state + its timeline events
   /api/actor/{actor_id}     one actor's state + its tasks
+  /api/jobs                 submitted jobs (job_submission KV table)
+  /api/job/{job_id}/logs    one job's captured output
 
     from ray_tpu.dashboard import start_dashboard
     url = start_dashboard(port=8265)
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
 from typing import Optional
 
-_PAGE = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>
- body { font-family: system-ui, sans-serif; margin: 2rem; color: #222; }
- h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.5rem; }
- table { border-collapse: collapse; margin-top: .5rem; }
- td, th { border: 1px solid #ccc; padding: .25rem .6rem; font-size: .85rem; }
- th { background: #f3f3f3; text-align: left; }
- code, pre { background: #f6f6f6; padding: 0 .25rem; }
- pre { padding: .5rem; overflow-x: auto; max-height: 20rem; }
- svg.spark { background: #fafafa; border: 1px solid #eee; }
- .sparkrow { display: flex; gap: 1.5rem; flex-wrap: wrap; }
- .sparkrow figure { margin: 0; }
- figcaption { font-size: .75rem; color: #555; }
-</style></head>
-<body>
-<h1>ray_tpu dashboard</h1>
-<div id="charts"></div>
-<div id="root">loading…</div>
-<h2>logs (tail)</h2><pre id="logs">…</pre>
-<script>
-const KINDS = ["nodes", "workers", "actors", "tasks", "placement_groups"];
-function esc(s) {
-  return String(s).replace(/[&<>"']/g, c => ({
-    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;"
-  })[c]);
-}
-function spark(points, label) {
-  if (!points.length) return "";
-  const w = 180, h = 40;
-  const max = Math.max(...points, 1e-9), min = Math.min(...points, 0);
-  const xs = points.map((p, i) => [
-    i * w / Math.max(points.length - 1, 1),
-    h - 2 - (p - min) / Math.max(max - min, 1e-9) * (h - 4)]);
-  const path = xs.map(([x, y], i) => (i ? "L" : "M") + x.toFixed(1) + " " + y.toFixed(1)).join(" ");
-  return `<figure><svg class="spark" width="${w}" height="${h}">` +
-    `<path d="${path}" fill="none" stroke="#36c" stroke-width="1.5"/></svg>` +
-    `<figcaption>${esc(label)} (now: ${points[points.length-1].toFixed(1)})</figcaption></figure>`;
-}
-async function refresh() {
-  const ts = await (await fetch("/api/metrics_timeseries")).json();
-  let charts = '<h2>metrics</h2><div class="sparkrow">';
-  for (const [name, pts] of Object.entries(ts.series))
-    charts += spark(pts, name);
-  document.getElementById("charts").innerHTML = charts + "</div>";
-
-  const root = document.getElementById("root");
-  let html = "";
-  const cluster = await (await fetch("/api/cluster")).json();
-  html += "<h2>Resources</h2><table><tr><th>resource</th><th>available</th><th>total</th></tr>";
-  for (const k of Object.keys(cluster.total).sort())
-    html += `<tr><td>${k}</td><td>${cluster.available[k] ?? 0}</td><td>${cluster.total[k]}</td></tr>`;
-  html += "</table>";
-  for (const kind of KINDS) {
-    const items = await (await fetch(`/api/${kind}`)).json();
-    html += `<h2>${kind} (${items.length})</h2>`;
-    if (!items.length) { html += "<p>(none)</p>"; continue; }
-    const cols = Object.keys(items[0]);
-    html += "<table><tr>" + cols.map(c => `<th>${c}</th>`).join("") +
-      (kind === "workers" ? "<th>profile</th>" : "") + "</tr>";
-    for (const it of items.slice(0, 50)) {
-      html += "<tr>" + cols.map(c => {
-        let cell = esc(JSON.stringify(it[c]));
-        if (kind === "tasks" && c === "task_id")
-          cell = `<a href="/api/task/${encodeURIComponent(it[c])}">${cell}</a>`;
-        if (kind === "actors" && c === "actor_id")
-          cell = `<a href="/api/actor/${encodeURIComponent(it[c])}">${cell}</a>`;
-        return `<td>${cell}</td>`;
-      }).join("");
-      if (kind === "workers")
-        html += `<td><a href="/api/profile/${it.worker_id}">stacks</a></td>`;
-      html += "</tr>";
-    }
-    html += "</table>";
-  }
-  const serveApps = await (await fetch("/api/serve/applications/")).json();
-  const appNames = Object.keys(serveApps);
-  html += `<h2>serve applications (${appNames.length})</h2>`;
-  if (appNames.length) {
-    html += "<table><tr><th>app</th><th>status</th><th>route</th><th>deployments</th></tr>";
-    for (const name of appNames) {
-      const a = serveApps[name];
-      const deps = Object.entries(a.deployments)
-        .map(([d, s]) => `${esc(d)}: ${esc(s.status)} x${s.num_replicas}`).join(", ");
-      html += `<tr><td>${esc(name)}</td><td>${esc(a.status)}</td>` +
-        `<td>${esc(a.route_prefix ?? "")}</td><td>${deps}</td></tr>`;
-    }
-    html += "</table>";
-  }
-  root.innerHTML = html;
-  const logs = await (await fetch("/api/logs?tail=40")).json();
-  document.getElementById("logs").textContent =
-    logs.lines.map(l => `[${l[0]}|${l[1].slice(0,8)}] ${l[2]}`).join("\\n");
-}
-refresh(); setInterval(refresh, 2000);
-</script></body></html>
-"""
+_CLIENT_DIR = os.path.join(os.path.dirname(__file__), "client")
 
 # Core gauges sampled into the timeseries ring (2s period, ~10min of
 # history at 300 samples).
@@ -181,7 +91,10 @@ class DashboardActor:
         )
         app.router.add_get("/api/task/{task_id}", self._task_detail)
         app.router.add_get("/api/actor/{actor_id}", self._actor_detail)
+        app.router.add_get("/api/jobs", self._jobs)
+        app.router.add_get("/api/job/{job_id}/logs", self._job_logs)
         app.router.add_get("/api/{kind}", self._list)
+        app.router.add_get("/static/{name}", self._static)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)
@@ -256,7 +169,19 @@ class DashboardActor:
     async def _index(self, request):
         from aiohttp import web
 
-        return web.Response(text=_PAGE, content_type="text/html")
+        with open(os.path.join(_CLIENT_DIR, "index.html")) as f:
+            return web.Response(text=f.read(), content_type="text/html")
+
+    async def _static(self, request):
+        from aiohttp import web
+
+        name = request.match_info["name"]
+        # Flat directory, explicit allowlist: no traversal surface.
+        types = {"app.js": "application/javascript", "style.css": "text/css"}
+        if name not in types:
+            return web.Response(status=404, text=f"no asset {name}")
+        with open(os.path.join(_CLIENT_DIR, name)) as f:
+            return web.Response(text=f.read(), content_type=types[name])
 
     async def _cluster(self, request):
         from aiohttp import web
@@ -440,6 +365,36 @@ class DashboardActor:
             )
         await asyncio.to_thread(post_event, key, payload)
         return web.json_response({"ok": True, "key": key})
+
+    # --------------------------------------------------------------- jobs
+    async def _jobs(self, request):
+        """Submitted jobs (reference: dashboard/modules/job/ — the job
+        head serves the submission table the SDK writes)."""
+        import asyncio
+
+        from aiohttp import web
+
+        from ..job_submission import JobSubmissionClient
+
+        # No swallow: an empty table already returns [] — any exception
+        # here is a real failure and must surface as a 500, not render
+        # as a healthy empty jobs list.
+        return web.json_response(
+            await asyncio.to_thread(lambda: JobSubmissionClient().list_jobs())
+        )
+
+    async def _job_logs(self, request):
+        import asyncio
+
+        from aiohttp import web
+
+        from ..job_submission import JobSubmissionClient
+
+        jid = request.match_info["job_id"]
+        text = await asyncio.to_thread(
+            lambda: JobSubmissionClient().get_job_logs(jid)
+        )
+        return web.Response(text=text, content_type="text/plain")
 
     # --------------------------------------------------------- drill-down
     async def _task_detail(self, request):

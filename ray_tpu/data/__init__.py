@@ -20,6 +20,8 @@ from .dataset import (
     read_csv,
     read_datasource,
     read_json,
+    read_lance,
+    read_mongo,
     read_numpy,
     read_images,
     read_parquet,
@@ -50,6 +52,8 @@ __all__ = [
     "read_csv",
     "read_datasource",
     "read_json",
+    "read_lance",
+    "read_mongo",
     "read_numpy",
     "read_images",
     "read_parquet",

@@ -128,6 +128,21 @@ class Limit(LogicalOp):
 
 
 @dataclass
+class FusedMap(LogicalOp):
+    """A run of map-likes merged by the OperatorFusion rule; one task
+    applies the whole chain (reference: rules/operator_fusion.py)."""
+
+    transforms: List[Transform]
+
+    @property
+    def name(self):  # type: ignore[override]
+        return "+".join(k for k, _ in self.transforms)
+
+    def is_map_like(self) -> bool:
+        return True
+
+
+@dataclass
 class AllToAll(LogicalOp):
     """Barrier ops executed over the materialized bundle list by a
     driver-side function (reference: AllToAllOperator)."""
@@ -160,32 +175,31 @@ class MapSegment:
     stop_after_rows: Optional[int] = None
 
 
-def optimize(plan: LogicalPlan) -> List[Any]:
-    """LogicalPlan -> [MapSegment | AllToAll, ...] with map fusion and
-    limit pushdown (reference: rules/operator_fusion.py, limit_pushdown.py)."""
-    ops = list(plan.ops)
+def optimize(plan: LogicalPlan, rules=None) -> List[Any]:
+    """LogicalPlan -> [MapSegment | AllToAll, ...]: run the named rule
+    pipeline (``_rules.DEFAULT_RULES`` — fusion, limit pushdown, column
+    pruning), then segment the rewritten ops for the streaming
+    executor (reference: LogicalOptimizer.optimize in
+    data/_internal/logical/optimizers.py)."""
+    from ._rules import apply_rules
 
-    # Limit pushdown: move Limit before row-preserving map-likes so the
-    # launcher can stop scheduling reads early.
-    changed = True
-    while changed:
-        changed = False
-        for i in range(1, len(ops)):
-            prev, cur = ops[i - 1], ops[i]
-            if (
-                isinstance(cur, Limit)
-                and isinstance(prev, MapLike)
-                and prev.row_preserving()
-            ):
-                ops[i - 1], ops[i] = cur, prev
-                changed = True
+    return segment(apply_rules(list(plan.ops), rules))
 
+
+def segment(ops: List[LogicalOp]) -> List[Any]:
+    """Attach (possibly fused) map chains to their upstream source so
+    read+transform run in one task; all-to-alls stay barriers."""
     segments: List[Any] = []
     cur_seg: Optional[MapSegment] = None
     for op in ops:
         if isinstance(op, (Read, InputData)):
             cur_seg = MapSegment(source=op, spec=MapSpec())
             segments.append(cur_seg)
+        elif isinstance(op, FusedMap):
+            if cur_seg is None:
+                cur_seg = MapSegment(source=None, spec=MapSpec())
+                segments.append(cur_seg)
+            cur_seg.spec.transforms.extend(op.transforms)
         elif isinstance(op, MapLike):
             if cur_seg is None:
                 cur_seg = MapSegment(source=None, spec=MapSpec())

@@ -115,7 +115,20 @@ class Dataset:
         def select(batch: "pa.Table", _cols=tuple(cols)):
             return batch.select(list(_cols))
 
-        return self.map_batches(select, batch_format="pyarrow")
+        # The projection tag lets ColumnPruningPushdown move this into a
+        # pruning-capable source read (parquet/lance/mongo).
+        return self._append(
+            MapLike(
+                "map_batches",
+                {
+                    "fn": select,
+                    "batch_size": None,
+                    "batch_format": "pyarrow",
+                    "fn_kwargs": None,
+                    "projection": tuple(cols),
+                },
+            )
+        )
 
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         def rename(batch: "pa.Table", _m=dict(mapping)):
@@ -476,6 +489,32 @@ def read_images(paths, *, size=None, mode=None, parallelism: int = -1,
     read_api.py read_images)."""
     return read_datasource(
         ImageDatasource(paths, size=size, mode=mode, **kw),
+        parallelism=parallelism, override_num_blocks=override_num_blocks,
+    )
+
+
+def read_lance(uri: str, *, columns=None, version=None,
+               parallelism: int = -1, override_num_blocks=None) -> Dataset:
+    """Fragment-parallel scan of a lance-style versioned columnar
+    dataset (reference: read_api.py read_lance); ``version=`` time
+    travels to an earlier committed snapshot."""
+    from .datasource import LanceDatasource
+
+    return read_datasource(
+        LanceDatasource(uri, columns=columns, version=version),
+        parallelism=parallelism, override_num_blocks=override_num_blocks,
+    )
+
+
+def read_mongo(collection_factory, *, filter=None, projection=None,
+               parallelism: int = -1, override_num_blocks=None) -> Dataset:
+    """_id-range-partitioned reads from a MongoDB-shaped collection
+    (reference: read_api.py read_mongo)."""
+    from .datasource import MongoDatasource
+
+    return read_datasource(
+        MongoDatasource(collection_factory, filter=filter,
+                        projection=projection),
         parallelism=parallelism, override_num_blocks=override_num_blocks,
     )
 

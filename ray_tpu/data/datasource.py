@@ -161,6 +161,14 @@ class ParquetDatasource(_FileDatasource):
         super().__init__(paths, file_extensions=[".parquet"], **kw)
         self._columns = columns
 
+    def prune_columns(self, cols: List[str]) -> bool:
+        """Accept a projection pushed down by the ColumnPruningPushdown
+        rule: parquet reads only the requested column chunks."""
+        if self._columns is not None and not set(cols) <= set(self._columns):
+            return False  # would widen the user's explicit projection
+        self._columns = list(cols)
+        return True
+
     def _read_file(self, path: str) -> Iterator[Block]:
         import pyarrow.parquet as pq
 
@@ -350,6 +358,246 @@ class WebDatasetDatasource(_FileDatasource):
         ]
         if rows:
             yield build_block(rows)
+
+
+class LanceDatasource(Datasource):
+    """Lance-style versioned columnar dataset (reference:
+    data/_internal/datasource/lance_datasource.py — fragment-parallel
+    scans with column projection and version time travel). The `lance`
+    wheel is unavailable offline, so this reads the same *shape* of
+    format natively: a dataset directory holds immutable fragment files
+    with ONE file per column per fragment plus versioned JSON manifests
+    (`_versions/<n>.manifest.json`). Column pruning therefore skips
+    whole files on disk, appends commit a new manifest version, and
+    `version=` reads any historical snapshot.
+
+    Fixtures come from :func:`write_lance_dataset` below.
+    """
+
+    def __init__(self, uri: str, columns: Optional[List[str]] = None,
+                 version: Optional[int] = None):
+        import json
+
+        vdir = os.path.join(uri, "_versions")
+        if not os.path.isdir(vdir):
+            raise ValueError(f"Not a lance-style dataset: {uri}")
+        versions = sorted(
+            int(f.split(".")[0]) for f in os.listdir(vdir)
+            if f.endswith(".manifest.json")
+        )
+        if not versions:
+            raise ValueError(f"No manifest versions in {uri}")
+        self.version = versions[-1] if version is None else version
+        if self.version not in versions:
+            raise ValueError(
+                f"version {version} not in {versions} for {uri}"
+            )
+        with open(os.path.join(
+            vdir, f"{self.version}.manifest.json"
+        )) as f:
+            self._manifest = json.load(f)
+        self._uri = uri
+        self._columns = columns
+        schema_cols = list(self._manifest["schema"])
+        want = schema_cols if columns is None else columns
+        missing = [c for c in want if c not in schema_cols]
+        if missing:
+            raise ValueError(f"unknown columns {missing}; have {schema_cols}")
+
+    def prune_columns(self, cols: List[str]) -> bool:
+        if self._columns is not None and not set(cols) <= set(self._columns):
+            return False
+        self._columns = list(cols)
+        return True
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        cols = self._columns or list(self._manifest["schema"])
+        return sum(
+            os.path.getsize(os.path.join(self._uri, frag["files"][c]))
+            for frag in self._manifest["fragments"]
+            for c in cols
+        )
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        import pyarrow.parquet as pq
+
+        uri = self._uri
+        cols = self._columns or list(self._manifest["schema"])
+        tasks = []
+        for frag in self._manifest["fragments"]:
+            files = {c: frag["files"][c] for c in cols}
+
+            def read(files=files) -> Iterable[Block]:
+                # One file per column: projection never touches the
+                # bytes of unselected columns.
+                arrays = {
+                    c: pq.read_table(os.path.join(uri, f)).column(c)
+                    for c, f in files.items()
+                }
+                yield pa.table(arrays)
+
+            size = sum(
+                os.path.getsize(os.path.join(uri, f))
+                for f in files.values()
+            )
+            tasks.append(ReadTask(read, BlockMetadata(
+                num_rows=frag["num_rows"], size_bytes=size,
+                input_files=sorted(files.values()),
+            )))
+        return tasks
+
+
+def write_lance_dataset(uri: str, table, *,
+                        max_rows_per_fragment: int = 1 << 20) -> int:
+    """Write/append an arrow table (or column dict) as a new version of
+    a lance-style dataset; returns the committed version number. An
+    append keeps every existing fragment immutable and commits a new
+    manifest listing old + new fragments — historical versions stay
+    readable (``LanceDatasource(uri, version=n)``)."""
+    import json
+
+    import pyarrow.parquet as pq
+
+    if isinstance(table, dict):
+        table = pa.table(table)
+    vdir = os.path.join(uri, "_versions")
+    ddir = os.path.join(uri, "data")
+    os.makedirs(vdir, exist_ok=True)
+    os.makedirs(ddir, exist_ok=True)
+    versions = sorted(
+        int(f.split(".")[0]) for f in os.listdir(vdir)
+        if f.endswith(".manifest.json")
+    )
+    if versions:
+        with open(os.path.join(
+            vdir, f"{versions[-1]}.manifest.json"
+        )) as f:
+            prev = json.load(f)
+        new_schema = {
+            c: str(table.schema.field(c).type) for c in table.column_names
+        }
+        if prev["schema"] != new_schema:
+            raise ValueError(
+                f"append schema {new_schema} != {prev['schema']}"
+            )
+        fragments = list(prev["fragments"])
+    else:
+        fragments = []
+    next_frag = max((f["id"] for f in fragments), default=-1) + 1
+    for start in range(0, max(table.num_rows, 1), max_rows_per_fragment):
+        piece = table.slice(start, max_rows_per_fragment)
+        files = {}
+        for c in table.column_names:
+            rel = os.path.join("data", f"frag-{next_frag}-{c}.parquet")
+            pq.write_table(
+                pa.table({c: piece.column(c)}),
+                os.path.join(uri, rel),
+            )
+            files[c] = rel
+        fragments.append({
+            "id": next_frag, "num_rows": piece.num_rows, "files": files,
+        })
+        next_frag += 1
+    version = (versions[-1] + 1) if versions else 1
+    manifest = {
+        "version": version,
+        "schema": {
+            c: str(table.schema.field(c).type) for c in table.column_names
+        },
+        "fragments": fragments,
+    }
+    tmp = os.path.join(vdir, f".{version}.manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(vdir, f"{version}.manifest.json"))
+    return version
+
+
+class MongoDatasource(Datasource):
+    """Cursor-paged reads from a MongoDB-shaped collection (reference:
+    data/_internal/datasource/mongo_datasource.py — partitions a
+    collection into _id ranges and reads each range in its own task).
+    Takes a ``collection_factory`` (a pymongo ``Collection`` or any
+    object with ``count_documents``/``find``-with-sort/skip/limit) so
+    tests run against local fixtures in this zero-egress environment.
+    ``projection`` prunes fields server-side; the ColumnPruningPushdown
+    rule feeds it from a following ``select_columns``."""
+
+    def __init__(self, collection_factory, filter: Optional[Dict] = None,
+                 projection: Optional[List[str]] = None):
+        self._factory = collection_factory
+        self._filter = filter or {}
+        self._projection = projection
+
+    def prune_columns(self, cols: List[str]) -> bool:
+        if self._projection is not None and not set(cols) <= set(
+            self._projection
+        ):
+            return False
+        self._projection = list(cols)
+        return True
+
+    def _proj_doc(self) -> Optional[Dict[str, int]]:
+        if self._projection is None:
+            return None
+        doc = {c: 1 for c in self._projection}
+        # mongo returns _id unless excluded explicitly
+        if "_id" not in doc:
+            doc["_id"] = 0
+        return doc
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        factory, base_filter = self._factory, self._filter
+        proj = self._proj_doc()
+        coll = factory() if callable(factory) else factory
+        total = coll.count_documents(base_filter)
+        n_parts = max(1, min(parallelism, total or 1))
+        # Split points: the _id at each boundary rank (reference uses
+        # the connector's $bucketAuto-style partitioner; skip+limit on
+        # the _id index is the portable equivalent).
+        bounds: List[Any] = []
+        for i in range(1, n_parts):
+            rank = (total * i) // n_parts
+            doc = next(iter(
+                coll.find(base_filter, {"_id": 1})
+                .sort("_id").skip(rank).limit(1)
+            ), None)
+            if doc is None:
+                # collection shrank since count_documents: fewer
+                # partitions, still full coverage (last range unbounded)
+                break
+            bounds.append(doc["_id"])
+        n_parts = len(bounds) + 1
+
+        def make(lo, hi):
+            def read() -> Iterable[Block]:
+                c = factory() if callable(factory) else factory
+                f = dict(base_filter)
+                id_range = dict(f.get("_id", {})) if isinstance(
+                    f.get("_id"), dict
+                ) else {}
+                if lo is not None:
+                    id_range["$gte"] = lo
+                if hi is not None:
+                    id_range["$lt"] = hi
+                if id_range:
+                    f["_id"] = id_range
+                # No sort: rows within one _id range need no order, and
+                # a projection may have excluded _id entirely.
+                rows = list(c.find(f, proj))
+                if rows:
+                    yield build_block(rows)
+
+            return read
+
+        edges = [None] + bounds + [None]
+        return [
+            ReadTask(
+                make(edges[i], edges[i + 1]),
+                BlockMetadata(num_rows=0, size_bytes=0),
+            )
+            for i in range(n_parts)
+        ]
 
 
 # ------------------------------------------------------------------ writes

@@ -38,6 +38,10 @@ class ScalingConfig:
 @dataclass
 class FailureConfig:
     max_failures: int = 0
+    # Separate budget for actor-loss (infra) failures — a preempted or
+    # OOM-killed trial actor restarts from its latest checkpoint
+    # without consuming max_failures (user-code error) budget.
+    infra_retries: int = 3
 
 
 @dataclass

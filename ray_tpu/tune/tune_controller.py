@@ -41,6 +41,7 @@ class Trial:
     best_score: Optional[float] = None
     error: Optional[str] = None
     num_failures: int = 0
+    num_infra_failures: int = 0
     local_dir: str = ""
 
     def public_state(self) -> Dict[str, Any]:
@@ -69,6 +70,7 @@ class TuneController:
         max_concurrent_trials: Optional[int] = None,
         stop: Optional[Dict[str, Any]] = None,
         max_failures: int = 0,
+        infra_retries: int = 3,
         experiment_dir: str = "",
         poll_interval_s: float = 0.05,
     ):
@@ -77,6 +79,14 @@ class TuneController:
         self.mode = mode
         self.stop_criteria = stop or {}
         self.max_failures = max_failures
+        # Infra failures (the actor died: worker preempted/OOM-killed/
+        # registration starved under load) retry on their OWN budget,
+        # separate from user-code failures — a wedged host must not
+        # convert healthy trials into ERROR results (reference: trial
+        # actor restarts in tune/execution/ray_trial_executor; the
+        # round-4 flakiness was exactly spurious actor loss under
+        # contention surfacing as trial errors).
+        self.infra_retries = infra_retries
         self.experiment_dir = experiment_dir
         os.makedirs(experiment_dir, exist_ok=True)
         # searcher; a user-supplied search_alg keeps its own settings.
@@ -210,6 +220,28 @@ class TuneController:
         trial.error = repr(err)
         self.searcher.on_trial_complete(trial.trial_id, error=True)
 
+    def _handle_infra_failure(self, trial: Trial, err: BaseException) -> None:
+        """The trial's actor died without the trainable raising (worker
+        preemption, OOM kill, a registration timeout under host load).
+        Restart from the latest checkpoint on the infra budget; only a
+        persistently failing environment errors the trial."""
+        trial.num_infra_failures += 1
+        if trial.num_infra_failures <= self.infra_retries:
+            import sys
+
+            sys.stderr.write(
+                f"tune: trial {trial.trial_id} lost its actor "
+                f"({err!r}); restarting "
+                f"({trial.num_infra_failures}/{self.infra_retries})\n"
+            )
+            self._stop_trial_actor(trial)
+            self._start_trial(trial, checkpoint_path=trial.latest_checkpoint)
+            return
+        self._stop_trial_actor(trial)
+        trial.status = ERROR
+        trial.error = repr(err)
+        self.searcher.on_trial_complete(trial.trial_id, error=True)
+
     def step(self) -> bool:
         """One controller iteration; returns False when all trials are done
         (reference: TuneController.step :666)."""
@@ -230,22 +262,30 @@ class TuneController:
         if not running:
             return False
 
-        # 2. poll all running actors for their next event
+        # 2. poll all running actors for their next event; each poll is
+        # pinned to the actor incarnation it was sent to so a mid-step
+        # restart (PBT exploit, infra retry) never consumes — or
+        # errors on — a stale ref from the killed predecessor.
         polls = {
-            t.trial_id: self._actors[t.trial_id].next_result.remote(
-                timeout=self.poll_interval_s
+            t.trial_id: (
+                self._actors[t.trial_id],
+                self._actors[t.trial_id].next_result.remote(
+                    timeout=self.poll_interval_s
+                ),
             )
             for t in running
             if t.trial_id in self._actors
         }
-        for trial_id, ref in polls.items():
+        for trial_id, (actor, ref) in polls.items():
             trial = self.get_trial(trial_id)
             if trial is None or trial.status != RUNNING:
                 continue  # stopped mid-step (scheduler/PBT)
+            if self._actors.get(trial_id) is not actor:
+                continue  # restarted mid-step: stale poll
             try:
                 kind, payload = ray_tpu.get(ref)
             except RayActorError as e:
-                self._handle_error(trial, e)
+                self._handle_infra_failure(trial, e)
                 continue
             if kind == "result":
                 self._handle_result(trial, payload[0], payload[1])

@@ -150,6 +150,7 @@ class Tuner:
             max_concurrent_trials=self._tune_config.max_concurrent_trials,
             stop=stop,
             max_failures=self._run_config.failure_config.max_failures,
+            infra_retries=self._run_config.failure_config.infra_retries,
             experiment_dir=exp_dir,
         )
         if self._restore_path and os.path.exists(

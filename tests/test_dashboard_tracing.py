@@ -1,6 +1,7 @@
 """Dashboard HTTP API + tracing spans."""
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -27,7 +28,24 @@ def test_dashboard_serves_state(cluster):
     ray_tpu.get([f.remote() for _ in range(3)])
 
     with urllib.request.urlopen(f"{url}/") as r:
-        assert b"ray_tpu dashboard" in r.read()
+        shell = r.read()
+        assert b"ray_tpu dashboard" in shell
+        assert b"/static/app.js" in shell  # SPA shell loads the app
+    with urllib.request.urlopen(f"{url}/static/app.js") as r:
+        js = r.read()
+        assert r.headers.get_content_type() == "application/javascript"
+        # every nav page has a renderer
+        for page in (b"overview", b"nodes", b"jobs", b"serve", b"profile"):
+            assert b"PAGES." + page in js
+    with urllib.request.urlopen(f"{url}/static/style.css") as r:
+        assert r.headers.get_content_type() == "text/css"
+    try:
+        urllib.request.urlopen(f"{url}/static/../__init__.py")
+        assert False, "traversal must 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    with urllib.request.urlopen(f"{url}/api/jobs") as r:
+        assert json.loads(r.read()) == []  # no jobs submitted yet
     with urllib.request.urlopen(f"{url}/api/cluster") as r:
         cluster_info = json.loads(r.read())
         assert cluster_info["total"]["CPU"] == 4.0

@@ -1,0 +1,248 @@
+"""Optimizer-rule framework + lance/mongo datasources.
+
+Reference strategy: data/tests/test_operator_fusion.py and
+test_optimizer.py assert on the *rewritten logical plan*, not just
+results — each rule gets plan-level unit tests here, then the sources
+get end-to-end reads against local fixtures.
+"""
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data._plan import FusedMap, Limit, LogicalPlan, MapLike, Read
+from ray_tpu.data._rules import (
+    ColumnPruningPushdown,
+    LimitPushdown,
+    OperatorFusion,
+    apply_rules,
+)
+from ray_tpu.data.datasource import (
+    LanceDatasource,
+    MongoDatasource,
+    ParquetDatasource,
+    write_lance_dataset,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ rule units
+
+
+def test_operator_fusion_merges_map_runs():
+    ops = [
+        MapLike("map_rows", {"fn": lambda r: r}),
+        MapLike("filter", {"fn": lambda r: True}),
+        MapLike("map_batches", {"fn": lambda b: b}),
+    ]
+    out = OperatorFusion().apply(ops)
+    assert len(out) == 1
+    assert isinstance(out[0], FusedMap)
+    assert [k for k, _ in out[0].transforms] == [
+        "map_rows", "filter", "map_batches",
+    ]
+    assert out[0].name == "map_rows+filter+map_batches"
+
+
+def test_limit_pushdown_crosses_row_preserving_only():
+    row = MapLike("map_rows", {"fn": lambda r: r})
+    flt = MapLike("filter", {"fn": lambda r: True})
+    out = LimitPushdown().apply([flt, row, Limit(5)])
+    # crosses map_rows, stops at filter (cardinality-changing)
+    assert [type(o).__name__ if not isinstance(o, MapLike) else o.kind
+            for o in out] == ["filter", "Limit", "map_rows"]
+
+
+def test_column_pruning_pushes_into_parquet(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": [1, 2], "b": [3, 4], "c": [5, 6]}), path)
+    ds = rd.read_parquet(path).select_columns(["a", "b"])
+    out = apply_rules(list(ds._plan.ops), [ColumnPruningPushdown()])
+    # the select op is gone; the (copied) source carries the projection
+    assert len(out) == 1
+    assert isinstance(out[0], Read)
+    assert out[0].datasource._columns == ["a", "b"]
+    # the original plan's shared datasource was NOT mutated
+    orig = ds._plan.ops[0].datasource
+    assert orig._columns is None
+
+
+def test_column_pruning_never_widens(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": [1], "b": [2]}), path)
+    src = ParquetDatasource(path, columns=["a"])
+    assert not src.prune_columns(["a", "b"])  # widening refused
+    assert src.prune_columns(["a"])
+
+
+def test_pruning_skipped_behind_filter(tmp_path):
+    # a filter between read and select may touch any column: no pushdown
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": [1, 2], "b": [3, 4]}), path)
+    ds = (
+        rd.read_parquet(path)
+        .filter(lambda r: r["b"] > 0)
+        .select_columns(["a"])
+    )
+    out = apply_rules(list(ds._plan.ops), [ColumnPruningPushdown()])
+    assert len(out) == 3  # unchanged
+    assert out[0].datasource._columns is None
+
+
+def test_select_columns_end_to_end(cluster, tmp_path):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(
+        pa.table({"a": [1, 2, 3], "b": [4.0, 5.0, 6.0], "c": ["x", "y", "z"]}),
+        path,
+    )
+    rows = rd.read_parquet(path).select_columns(["a", "c"]).take_all()
+    assert rows == [
+        {"a": 1, "c": "x"}, {"a": 2, "c": "y"}, {"a": 3, "c": "z"},
+    ]
+
+
+# ----------------------------------------------------------------- lance
+
+
+def test_lance_roundtrip_and_projection(cluster, tmp_path):
+    uri = str(tmp_path / "ds.lance")
+    v1 = write_lance_dataset(
+        uri,
+        {"id": list(range(10)), "text": [f"row{i}" for i in range(10)]},
+        max_rows_per_fragment=4,
+    )
+    assert v1 == 1
+    ds = rd.read_lance(uri)
+    assert ds.count() == 10
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(10))
+    # fragment-parallel: 10 rows at 4/fragment = 3 fragments
+    assert len(LanceDatasource(uri).get_read_tasks(8)) == 3
+
+    # column projection reads only the id files
+    only_ids = rd.read_lance(uri, columns=["id"]).take_all()
+    assert all(set(r) == {"id"} for r in only_ids)
+
+    # select_columns pushes down into the scan
+    ds2 = rd.read_lance(uri).select_columns(["text"])
+    out = apply_rules(list(ds2._plan.ops), [ColumnPruningPushdown()])
+    assert len(out) == 1
+    assert out[0].datasource._columns == ["text"]
+
+
+def test_lance_append_and_time_travel(cluster, tmp_path):
+    uri = str(tmp_path / "ds.lance")
+    write_lance_dataset(uri, {"id": [1, 2], "text": ["a", "b"]})
+    v2 = write_lance_dataset(uri, {"id": [3], "text": ["c"]})
+    assert v2 == 2
+    assert rd.read_lance(uri).count() == 3
+    assert rd.read_lance(uri, version=1).count() == 2
+    with pytest.raises(ValueError):
+        write_lance_dataset(uri, {"other": [1]})  # schema mismatch
+    with pytest.raises(ValueError):
+        # same names, changed type: also refused (old fragments would
+        # silently disagree with the new manifest)
+        write_lance_dataset(uri, {"id": ["x"], "text": ["c"]})
+    with pytest.raises(ValueError):
+        rd.read_lance(uri, version=9)
+    with pytest.raises(ValueError):
+        rd.read_lance(uri, columns=["nope"])
+
+
+# ----------------------------------------------------------------- mongo
+
+
+class _FakeCursor:
+    def __init__(self, docs):
+        self._docs = docs
+
+    def sort(self, key):
+        return _FakeCursor(sorted(self._docs, key=lambda d: d[key]))
+
+    def skip(self, n):
+        return _FakeCursor(self._docs[n:])
+
+    def limit(self, n):
+        return _FakeCursor(self._docs[:n])
+
+    def __iter__(self):
+        return iter(self._docs)
+
+
+class _FakeCollection:
+    """The pymongo Collection surface MongoDatasource drives: equality
+    and $gte/$lt range filters plus include/exclude projections."""
+
+    def __init__(self, docs):
+        self._docs = docs
+
+    @staticmethod
+    def _match(doc, flt):
+        for k, cond in flt.items():
+            if isinstance(cond, dict):
+                if "$gte" in cond and not doc[k] >= cond["$gte"]:
+                    return False
+                if "$lt" in cond and not doc[k] < cond["$lt"]:
+                    return False
+            elif doc.get(k) != cond:
+                return False
+        return True
+
+    def count_documents(self, flt):
+        return sum(1 for d in self._docs if self._match(d, flt))
+
+    def find(self, flt, projection=None):
+        docs = [d for d in self._docs if self._match(d, flt)]
+        if projection:
+            include = {k for k, v in projection.items() if v}
+            exclude = {k for k, v in projection.items() if not v}
+            docs = [
+                {k: v for k, v in d.items()
+                 if (not include or k in include) and k not in exclude}
+                # _id rides along unless excluded, as in mongo
+                | ({"_id": d["_id"]}
+                   if "_id" not in exclude and include else {})
+                for d in docs
+            ]
+        return _FakeCursor(docs)
+
+
+def _make_coll(n=20):
+    return _FakeCollection([
+        {"_id": i, "x": i * i, "tag": "even" if i % 2 == 0 else "odd"}
+        for i in range(n)
+    ])
+
+
+def test_mongo_partitioned_read(cluster):
+    coll = _make_coll()
+    ds = rd.read_mongo(lambda: coll, parallelism=4)
+    # 4 disjoint _id ranges cover the collection exactly once
+    assert len(MongoDatasource(lambda: coll).get_read_tasks(4)) == 4
+    rows = ds.take_all()
+    assert sorted(r["_id"] for r in rows) == list(range(20))
+    assert all(r["x"] == r["_id"] ** 2 for r in rows)
+
+
+def test_mongo_filter_and_projection(cluster):
+    coll = _make_coll()
+    rows = rd.read_mongo(
+        lambda: coll, filter={"tag": "even"}, projection=["x"],
+        parallelism=2,
+    ).take_all()
+    assert len(rows) == 10
+    assert all(set(r) == {"x"} for r in rows)
+
+    # select_columns pushes its projection into the cursor
+    ds = rd.read_mongo(lambda: coll).select_columns(["tag"])
+    out = apply_rules(list(ds._plan.ops), [ColumnPruningPushdown()])
+    assert len(out) == 1
+    assert out[0].datasource._projection == ["tag"]
+    rows = ds.take_all()
+    assert all(set(r) == {"tag"} for r in rows)
