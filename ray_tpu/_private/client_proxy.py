@@ -89,6 +89,7 @@ class ClientProxyServer:
 
     def _handshake(self, conn) -> None:
         try:
+            transport.server_handshake(conn, self._authkey, tcp=True)
             msg = conn.recv()
             if not (isinstance(msg, dict) and msg.get("type") == "proxy_hello"):
                 conn.close()
@@ -179,10 +180,15 @@ class _Session:
         self._pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="proxy-session"
         )
+        # autostart=False: the first client frame may already be in the
+        # socket buffer (1-RTT connect), and the reader must not deliver
+        # it before self.conn is assigned.
         self.conn = PeerConn(
             conn, push_handler=self._on_msg,
             on_close=self._on_close, name="proxy-session",
+            autostart=False,
         )
+        self.conn.start()
         self._done.wait()
 
     def _forward_push(self, msg: Dict[str, Any]) -> None:
@@ -410,6 +416,9 @@ def _session_main() -> int:
     threading.Thread(target=_abandon_watchdog, daemon=True).start()
     try:
         conn = listener.accept()
+        transport.server_handshake(
+            conn, bytes.fromhex(cfg["authkey"]), tcp=True
+        )
         attached.set()
     finally:
         listener.close()

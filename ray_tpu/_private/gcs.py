@@ -308,7 +308,11 @@ class GcsServer:
             os.unlink(address)  # stale socket from a previous head
         except OSError:
             pass
-        self._listener = Listener(address, family="AF_UNIX", authkey=authkey)
+        # authkey=None: auth is deferred to each peer's reader thread
+        # (transport.server_handshake) so a worker connect storm never
+        # serializes its HMAC round-trips through the accept loop.
+        self._authkey = authkey
+        self._listener = Listener(address, family="AF_UNIX", authkey=None)
         # Optional network control plane: remote node daemons, their
         # workers and remote drivers connect here (reference: the GCS
         # gRPC server, src/ray/rpc/grpc_server.h).
@@ -324,7 +328,7 @@ class GcsServer:
             self.tcp_address = f"{transport.node_ip()}:{port}"
             self._tcp_accept_thread = threading.Thread(
                 target=self._accept_loop_on,
-                args=(self._tcp_listener,),
+                args=(self._tcp_listener, True),
                 name="gcs-accept-tcp",
                 daemon=True,
             )
@@ -400,7 +404,7 @@ class GcsServer:
     def _accept_loop(self):
         self._accept_loop_on(self._listener)
 
-    def _accept_loop_on(self, listener):
+    def _accept_loop_on(self, listener, tcp: bool = False):
         while not self._shutdown:
             try:
                 conn = listener.accept()
@@ -409,12 +413,17 @@ class GcsServer:
             except Exception:  # noqa: BLE001 - failed auth handshake etc.
                 continue
             state: Dict[str, Any] = {}
+            from . import transport
+
             peer = PeerConn(
                 conn,
                 push_handler=lambda msg, s=state: self._dispatch(s, msg),
                 on_close=lambda s=state: self._on_peer_close(s),
                 name="gcs-peer",
                 autostart=False,
+                handshake=lambda c: transport.server_handshake(
+                    c, self._authkey, tcp=tcp
+                ),
             )
             state["peer"] = peer
             with self._lock:
@@ -2385,6 +2394,19 @@ class GcsServer:
             now = time.time()
             self._drain_tick(now)
             self._sweep_stack_waiters(now)
+            # Reap workers that died between fork and registration
+            # (crash during bootstrap): a stuck W_STARTING entry would
+            # block pool-growth accounting forever.
+            with self._lock:
+                stuck = [
+                    w.worker_id.binary()
+                    for w in self.workers.values()
+                    if w.state == W_STARTING
+                    and w.proc is not None
+                    and w.proc.poll() is not None
+                ]
+            for wid in stuck:
+                self._handle_worker_death(wid, "died during startup")
             with self._lock:
                 stale = [
                     n.node_id.binary()
@@ -2804,7 +2826,16 @@ class GcsServer:
                     or pool_same_kind + starting
                     < max(int(node.total.get("CPU", 1)), 1)
                 )
-                if starting < claims[nid] and can_grow:
+                # Admission control: never boot more interpreters at
+                # once than the host can actually run — queued claims
+                # re-spawn as registrations complete (each hello wakes
+                # the scheduler), so a storm drains at the boot rate
+                # instead of thrashing (reference: worker_pool.cc
+                # maximum_startup_concurrency).
+                cap = RayConfig.max_starting_workers_per_node or max(
+                    4, os.cpu_count() or 1
+                )
+                if starting < claims[nid] and can_grow and starting < cap:
                     self._spawn_worker(node, tpu=needs_tpu)
                 continue
             worker.state = W_BUSY
@@ -2871,7 +2902,15 @@ class GcsServer:
         logdir = os.path.join(self.session_dir, "logs")
         os.makedirs(logdir, exist_ok=True)
         log_path = os.path.join(logdir, f"worker-{wid.hex()[:8]}.out")
-        w.proc = self._spawner.spawn(env, log_path, tpu=tpu)
+        # Pipelined spawn returns before the fork completes; a failed
+        # fork must tear down the W_STARTING entry or pool accounting
+        # would count a ghost forever.
+        w.proc = self._spawner.spawn(
+            env, log_path, tpu=tpu,
+            on_fail=lambda b=wid.binary(): self._handle_worker_death(
+                b, "worker spawn failed"
+            ),
+        )
         return w
 
     def _handle_worker_death(self, wid: bytes, reason: str, respawn: bool = False):

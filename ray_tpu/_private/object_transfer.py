@@ -56,6 +56,10 @@ class ObjectTransferServer:
                 push_handler=lambda msg, h=holder: self._handle(h["peer"], msg),
                 name="obj-transfer",
                 autostart=False,
+                handshake=lambda c: transport.server_handshake(
+                    c, self._authkey,
+                    tcp=transport.is_tcp_address(self.address),
+                ),
             )
             holder["peer"] = peer
             self._peers.append(peer)

@@ -62,8 +62,14 @@ class PeerConn:
         on_close: Optional[Callable[[], None]] = None,
         name: str = "peer",
         autostart: bool = True,
+        handshake: Optional[Callable[[Connection], None]] = None,
     ):
         self._conn = conn
+        # Deferred auth: the listener accepted raw so its accept loop
+        # never serializes HMAC challenges; the reader thread completes
+        # the handshake before the first frame (a connect storm of N
+        # workers then authenticates on N threads, not one).
+        self._handshake = handshake
         self._send_lock = threading.Lock()
         self._out: List[Any] = []
         self._pending: Dict[int, Future] = {}
@@ -214,6 +220,15 @@ class PeerConn:
         loads = pickle.loads
         decode = _fp.decode if _fp is not None else None
         try:
+            if self._handshake is not None:
+                try:
+                    self._handshake(self._conn)
+                except Exception:  # noqa: BLE001 - failed auth
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                    return  # finally below runs the close bookkeeping
             while True:
                 buf = recv_bytes()
                 if buf and buf[0] == _FAST_MAGIC and decode is not None:

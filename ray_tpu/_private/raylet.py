@@ -154,8 +154,10 @@ class NodeDaemon:
             pass
         from multiprocessing.connection import Listener as _Listener
 
+        # Auth is the transport token handshake, run on each lease
+        # conn's reader thread (never in the accept loop).
         self._lease_listener = _Listener(
-            self._lease_addr, family="AF_UNIX", authkey=authkey
+            self._lease_addr, family="AF_UNIX", authkey=None
         )
         os.environ["RAY_TPU_LOCAL_RAYLET"] = self._lease_addr
         threading.Thread(
@@ -311,6 +313,9 @@ class NodeDaemon:
                 on_close=lambda h=holder: self._on_lease_peer_close(h),
                 name="raylet-lease",
                 autostart=False,
+                handshake=lambda c: transport.server_handshake(
+                    c, self.authkey
+                ),
             )
             holder["peer"] = peer
             peer.start()

@@ -17,22 +17,62 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, Optional
 
 
 class ForkedProc:
     """Popen-shaped handle for a process forked by the zygote (which is
-    its parent — we cannot waitpid it, only signal/poll by pid)."""
+    its parent — we cannot waitpid it, only signal/poll by pid).
 
-    def __init__(self, pid: int):
-        self.pid = pid
+    The pid may arrive asynchronously: ``spawn()`` pipelines the fork
+    request and returns immediately; the spawner's reply reader
+    resolves the pid (or marks the fork failed) when the zygote
+    answers. Signal/poll calls briefly wait for that resolution."""
+
+    def __init__(self, pid: Optional[int] = None,
+                 on_fail: Optional[callable] = None):
+        self._pid = pid
+        self._resolved = threading.Event()
+        if pid is not None:
+            self._resolved.set()
         self._returncode: Optional[int] = None
+        self._on_fail = on_fail
+        self._pending_signal: Optional[int] = None
+
+    @property
+    def pid(self) -> int:
+        """Non-blocking: 0 while the fork is still in flight. Callers
+        (state API, log labels) read this under the control-plane lock,
+        so it must NEVER wait on the zygote."""
+        return self._pid or 0
+
+    def _resolve(self, pid: int) -> None:
+        self._pid = pid
+        self._resolved.set()
+        sig, self._pending_signal = self._pending_signal, None
+        if sig is not None:
+            try:
+                os.kill(pid, sig)
+            except ProcessLookupError:
+                pass
+
+    def _fail(self) -> None:
+        self._returncode = 1
+        self._resolved.set()
+        if self._on_fail is not None:
+            try:
+                self._on_fail()
+            except Exception:  # noqa: BLE001 - death bookkeeping best-effort
+                pass
 
     def poll(self) -> Optional[int]:
         if self._returncode is not None:
             return self._returncode
+        if not self._resolved.is_set():
+            return None  # fork still in flight
         try:
-            os.kill(self.pid, 0)
+            os.kill(self._pid, 0)
             return None
         except ProcessLookupError:
             self._returncode = 0  # exit status unknowable: not our child
@@ -48,26 +88,47 @@ class ForkedProc:
             time.sleep(0.02)
         return self._returncode or 0
 
-    def terminate(self) -> None:
+    def _signal(self, sig: int) -> None:
+        if not self._resolved.is_set():
+            # Fork in flight: deliver the moment the pid lands (the
+            # reply loop runs _resolve) so a kill is never lost.
+            self._pending_signal = sig
+            if not self._resolved.is_set():
+                return
+        pid = self._pid or 0
+        if pid <= 0:
+            return  # fork failed: nothing to signal
         try:
-            os.kill(self.pid, signal.SIGTERM)
+            os.kill(pid, sig)
         except ProcessLookupError:
             pass
 
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
     def kill(self) -> None:
-        try:
-            os.kill(self.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
+        self._signal(signal.SIGKILL)
 
 
 class WorkerSpawner:
-    """One per control-plane process (GCS head / raylet)."""
+    """One per control-plane process (GCS head / raylet).
+
+    Fork requests are PIPELINED: ``spawn()`` writes the request and
+    returns an unresolved :class:`ForkedProc` immediately; a reply
+    reader thread resolves pids FIFO as the zygote answers. The
+    scheduler thread therefore never blocks on a fork — a burst of N
+    actor creations issues N fork requests back-to-back (reference:
+    worker_pool.cc StartWorkerProcess is likewise async; the pool
+    learns the pid from the registration callback)."""
 
     def __init__(self, base_env: Dict[str, str]):
         self._base_env = dict(base_env)
         self._lock = threading.Lock()
         self._zygote: Optional[subprocess.Popen] = None
+        # FIFO of ForkedProcs awaiting their pid from the CURRENT
+        # zygote (replies are in request order; a new zygote gets a
+        # fresh deque captured by its own reader thread).
+        self._awaiting: "deque[ForkedProc]" = deque()
 
     def _ensure_zygote(self) -> Optional[subprocess.Popen]:
         z = self._zygote
@@ -90,24 +151,65 @@ class WorkerSpawner:
             )
         except Exception:  # noqa: BLE001
             self._zygote = None
+            return None
+        self._awaiting = deque()
+        threading.Thread(
+            target=self._reply_loop,
+            args=(self._zygote, self._awaiting),
+            name="zygote-replies",
+            daemon=True,
+        ).start()
         return self._zygote
 
-    def spawn(self, env: Dict[str, str], log_path: str, tpu: bool = False):
+    def _reply_loop(self, z: subprocess.Popen,
+                    awaiting: "deque[ForkedProc]") -> None:
+        for line in z.stdout:
+            try:
+                reply = json.loads(line)
+            except ValueError:
+                reply = {}
+            try:
+                proc = awaiting.popleft()
+            except IndexError:
+                continue  # reply with no waiter: protocol desync
+            pid = reply.get("pid")
+            if pid:
+                proc._resolve(pid)
+            else:
+                proc._fail()
+        # Zygote died: every queued fork is lost. Do NOT hold the
+        # spawner lock while failing procs — their on_fail callbacks
+        # take the control-plane lock (opposite order to spawn()).
+        with self._lock:
+            if self._zygote is z:
+                self._zygote = None
+        while True:
+            try:
+                awaiting.popleft()._fail()
+            except IndexError:
+                break
+
+    def spawn(self, env: Dict[str, str], log_path: str, tpu: bool = False,
+              on_fail=None):
         """Returns a Popen-shaped handle (ForkedProc or Popen)."""
         if not tpu:
             with self._lock:
                 z = self._ensure_zygote()
                 if z is not None:
                     try:
+                        env = dict(env)
+                        env["RAY_TPU_SPAWNED_AT"] = repr(time.time())
                         req = {"env": env, "log": log_path}
+                        proc = ForkedProc(on_fail=on_fail)
+                        self._awaiting.append(proc)
                         z.stdin.write((json.dumps(req) + "\n").encode())
                         z.stdin.flush()
-                        line = z.stdout.readline()
-                        reply = json.loads(line) if line else {}
-                        pid = reply.get("pid")
-                        if pid:
-                            return ForkedProc(pid)
+                        return proc
                     except Exception:  # noqa: BLE001 - zygote died: cold path
+                        try:
+                            self._awaiting.remove(proc)
+                        except ValueError:
+                            pass
                         try:
                             z.kill()
                         except Exception:  # noqa: BLE001

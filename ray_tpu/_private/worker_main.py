@@ -950,7 +950,9 @@ def main():
         os.unlink(direct_addr)
     except FileNotFoundError:
         pass
-    direct_listener = Listener(direct_addr, family="AF_UNIX", authkey=authkey)
+    # Token auth runs on each direct conn's reader thread; the accept
+    # loop never blocks on a handshake.
+    direct_listener = Listener(direct_addr, family="AF_UNIX", authkey=None)
 
     def direct_accept_loop():
         while True:
@@ -982,28 +984,55 @@ def main():
                         (msg["spec"], (h["peer"], msg["req_id"], False))
                     )
 
+            from . import transport as _transport
+
             peer = PeerConn(
                 conn, push_handler=on_direct, name="direct-serve",
                 autostart=False,
+                handshake=lambda c: _transport.server_handshake(c, authkey),
             )
             holder["peer"] = peer
             peer.start()
 
     threading.Thread(target=direct_accept_loop, daemon=True).start()
 
+    _spawned_at = os.environ.get("RAY_TPU_SPAWNED_AT")
+    _t_pre_client = time.perf_counter()
+    _prof = None
+    if os.environ.get("RAY_TPU_BOOT_PROFILE"):
+        import cProfile
+
+        _prof = cProfile.Profile()
+        _prof.enable()
     client = CoreClient(
         address, authkey, role="worker", worker_id=worker_id,
         push_handler=push, direct_addr=direct_addr,
     )
+    if _prof is not None:
+        import io
+        import pstats
+
+        _prof.disable()
+        s = io.StringIO()
+        pstats.Stats(_prof, stream=s).sort_stats("cumulative").print_stats(15)
+        print(s.getvalue())
     rt_holder["boot_client"] = client
+    if _spawned_at and os.environ.get("RAY_TPU_BOOT_TRACE"):
+        # Boot latency: spawn request -> registered. The spawn path is
+        # the actor-creation throughput ceiling; this line makes it
+        # measurable from the worker logs.
+        print(
+            f"worker boot: {time.time() - float(_spawned_at):.3f}s total, "
+            f"client {time.perf_counter() - _t_pre_client:.3f}s",
+        )
     raylet_addr = os.environ.get("RAY_TPU_LOCAL_RAYLET")
     if raylet_addr and os.environ.get("RAY_TPU_LOCAL_ONLY"):
         # Report our direct socket to the owning raylet so it can lease
         # this worker to local clients (local dispatch authority).
-        from multiprocessing.connection import Client as _MpClient
+        from . import transport as _transport
 
         try:
-            rl = _MpClient(raylet_addr, family="AF_UNIX", authkey=authkey)
+            rl = _transport.connect(raylet_addr, authkey)
             rl.send(
                 {
                     "type": "worker_hello",

@@ -67,8 +67,20 @@ def main() -> None:
     signal.signal(signal.SIGCHLD, signal.SIG_IGN)
     # Warm the expensive imports ONCE, before any fork. worker_main
     # pulls in the whole ray_tpu core (not jax — workers import that
-    # lazily when a task needs it).
+    # lazily when a task needs it). The modules the BOOT path imports
+    # lazily must also be warmed here: anything left out is re-imported
+    # — and source-compiled — by every forked child, which was ~70% of
+    # CoreClient.__init__ time in the boot profile.
     from . import worker_main  # noqa: F401
+    from . import (  # noqa: F401
+        native_store,
+        object_store,
+        object_transfer,
+        ref_tracker,
+        runtime_env,
+        worker,
+    )
+    import ray_tpu  # noqa: F401  (public API: tasks resolve through it)
 
     stdin = sys.stdin
     stdout = sys.stdout

@@ -217,9 +217,12 @@ PAGES.logs = async () => {
   const prefix = tableFilters.__logprefix || "";
   const logs = await getJSON(
     `/api/logs?tail=300&prefix=${encodeURIComponent(prefix)}`);
-  const atBottom = $("#logpre") &&
-    $("#logpre").scrollTop + $("#logpre").clientHeight >=
-    $("#logpre").scrollHeight - 4;
+  // Preserve the reading position across refreshes: follow the tail
+  // only when pinned at the bottom, else restore the exact offset.
+  const prev = $("#logpre");
+  const atBottom = !prev ||
+    prev.scrollTop + prev.clientHeight >= prev.scrollHeight - 4;
+  const prevTop = prev ? prev.scrollTop : 0;
   $("#page").innerHTML = "<h1>Logs</h1>" +
     `<div class="toolbar"><input id="prefix" placeholder="worker prefix…" ` +
     `value="${esc(prefix)}"></div>` +
@@ -227,7 +230,7 @@ PAGES.logs = async () => {
       esc(`[${l[0]}|${String(l[1]).slice(0, 8)}] ${l[2]}`)).join("\n") +
     "</pre>";
   const pre = $("#logpre");
-  if (atBottom !== false) pre.scrollTop = pre.scrollHeight;
+  pre.scrollTop = atBottom ? pre.scrollHeight : prevTop;
   $("#prefix").addEventListener("change", (e) => {
     tableFilters.__logprefix = e.target.value;
     route();
