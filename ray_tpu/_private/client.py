@@ -51,6 +51,13 @@ class CoreClient:
         self.worker_id = worker_id or WorkerID.from_random()
         self.role = role
         self.store = ObjectStore()
+        # Read-your-writes contract for state reads (list/timeline):
+        # worker processes bind this to their _DoneBatcher.flush so
+        # locally-coalesced task_done records reach the GCS before a
+        # state query from this process is answered (the GCS-side flush
+        # barrier cannot ping the requesting worker — its conn reader
+        # thread is busy carrying the request; gcs._barrier_flush_events).
+        self.pre_state_read_flush: Optional[Callable[[], None]] = None
         self._push_handler = push_handler or (lambda msg: None)
         conn = transport.connect(address, authkey)
         self.conn = PeerConn(
@@ -1215,6 +1222,14 @@ class CoreClient:
 
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Dict[str, Any]:
         return self.conn.request(msg, timeout=timeout)
+
+    def state_read(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """A request that reads task/object state: flushes this
+        process's own coalesced completion records first so the answer
+        includes everything this process has already observed finish."""
+        if self.pre_state_read_flush is not None:
+            self.pre_state_read_flush()
+        return self.request(msg)
 
     def send(self, msg: Dict[str, Any]) -> None:
         self.conn.send(msg)

@@ -103,6 +103,9 @@ class WorkerHandle:
     actor_id: Optional[ActorID] = None
     # Dispatched-but-unfinished specs (task_id -> spec); failed on death.
     inflight: Dict[bytes, TaskSpec] = field(default_factory=dict)
+    # Startup reaping: remote spawns have proc=None, so a raylet that
+    # never delivers the worker is caught by register-timeout instead.
+    spawned_at: float = field(default_factory=time.time)
     # TPU-visible worker: spawned with accelerator access (reference:
     # accelerator visibility env vars set per worker —
     # _private/accelerators/tpu.py TPU_VISIBLE_CHIPS). Non-TPU workers
@@ -227,6 +230,10 @@ class GcsServer:
         # (reference: GcsTaskManager task-event store,
         # gcs_task_manager.h:85). Bounded: oldest events roll off.
         self.task_events: deque = deque(maxlen=100_000)
+        # Outstanding flush barriers for read-your-writes state listings
+        # (token -> {"need", "got", "ev"}); see _barrier_flush_events.
+        self._flush_waits: Dict[int, Dict[str, Any]] = {}
+        self._flush_token = 0
         # Streaming-generator state per task (reference: streaming
         # return handling, task_manager.h:208): item count as the
         # executor seals yields, total+error once the generator ends,
@@ -1517,12 +1524,72 @@ class GcsServer:
 
     # ------------------------------------------------------------ state API
 
+    def _barrier_flush_events(
+        self, timeout: float = 0.25, exclude_wid: Optional[bytes] = None
+    ) -> None:
+        """Read-your-writes for task/object listings. Completions from
+        direct/leased calls are coalesced by each worker's _DoneBatcher
+        (worker_main.py) for a few ms before the GCS sees them, so a
+        list issued right after get() could miss tasks the caller knows
+        finished. Ask every live worker to flush and wait briefly for
+        acks — the submit hot path stays batched; the rare observability
+        read pays one round-trip (reference: the state API forces a
+        task-event buffer flush on read, task_event_buffer.h).
+
+        ``exclude_wid``: when the listing request came FROM a worker, its
+        conn reader thread is the one blocked in this barrier — pinging
+        it would deadlock until timeout (its ack could never be
+        dispatched). The worker flushes its own batcher client-side
+        before sending the request instead (state/api.py _list)."""
+        with self._lock:
+            conns = [
+                w.conn
+                for w in self.workers.values()
+                if w.conn is not None
+                and w.state != W_STARTING
+                and w.worker_id.binary() != exclude_wid
+            ]
+            if not conns:
+                return
+            self._flush_token += 1
+            token = self._flush_token
+            entry: Dict[str, Any] = {
+                "need": 0, "got": 0, "ev": threading.Event()
+            }
+            self._flush_waits[token] = entry
+        sent = 0
+        for conn in conns:
+            try:
+                conn.send({"type": "flush_events", "token": token})
+                sent += 1
+            except ConnectionLost:
+                pass
+        with self._lock:
+            entry["need"] = sent
+            if entry["got"] >= sent:
+                entry["ev"].set()
+        if sent:
+            entry["ev"].wait(timeout)
+        with self._lock:
+            self._flush_waits.pop(token, None)
+
+    def _h_events_flushed(self, state, msg):
+        with self._lock:
+            entry = self._flush_waits.get(msg.get("token"))
+            if entry is None:
+                return
+            entry["got"] += 1
+            if entry["need"] and entry["got"] >= entry["need"]:
+                entry["ev"].set()
+
     def _h_list_state(self, state, msg):
         """Typed state listing for ray_tpu.util.state (reference:
         util/state/api.py backed by the GCS + state aggregator)."""
         kind = msg["kind"]
         limit = msg.get("limit", 1000)
         filters = msg.get("filters") or []
+        if kind in ("tasks", "objects"):
+            self._barrier_flush_events(exclude_wid=state.get("worker_id"))
         with self._lock:
             if kind == "actors":
                 items = [
@@ -1643,6 +1710,9 @@ class GcsServer:
         )
 
     def _h_get_task_events(self, state, msg):
+        # Timeline/summary reads the same batched deque as list_state:
+        # same read-your-writes barrier.
+        self._barrier_flush_events(exclude_wid=state.get("worker_id"))
         with self._lock:
             events = [
                 {
@@ -2402,8 +2472,17 @@ class GcsServer:
                     w.worker_id.binary()
                     for w in self.workers.values()
                     if w.state == W_STARTING
-                    and w.proc is not None
-                    and w.proc.poll() is not None
+                    and (
+                        (w.proc is not None and w.proc.poll() is not None)
+                        # Register-timeout deadline for EVERY starting
+                        # worker: remote spawns (proc=None, raylet gone
+                        # or message lost) and local pipelined forks a
+                        # wedged-but-alive zygote never resolves (their
+                        # poll() stays None forever) — either would hold
+                        # a startup-cap slot indefinitely.
+                        or now - w.spawned_at
+                        > RayConfig.worker_register_timeout_s
+                    )
                 ]
             for wid in stuck:
                 self._handle_worker_death(wid, "died during startup")
@@ -2833,7 +2912,7 @@ class GcsServer:
                 # instead of thrashing (reference: worker_pool.cc
                 # maximum_startup_concurrency).
                 cap = RayConfig.max_starting_workers_per_node or max(
-                    4, os.cpu_count() or 1
+                    4, int(node.total.get("CPU", 1))
                 )
                 if starting < claims[nid] and can_grow and starting < cap:
                     self._spawn_worker(node, tpu=needs_tpu)
@@ -2912,6 +2991,13 @@ class GcsServer:
             ),
         )
         return w
+
+    def _h_worker_spawn_failed(self, state, msg):
+        """A remote raylet could not start a head-requested worker (both
+        the zygote fork and the cold-path Popen failed): release the
+        W_STARTING entry so its startup-cap slot and claimed task free
+        up (the local-spawn analogue is the on_fail in _spawn_worker)."""
+        self._handle_worker_death(msg["worker_id"], "worker spawn failed")
 
     def _handle_worker_death(self, wid: bytes, reason: str, respawn: bool = False):
         from ..exceptions import OutOfMemoryError, WorkerCrashedError

@@ -231,9 +231,25 @@ class NodeDaemon:
                 )
         os.makedirs(self.logs_dir, exist_ok=True)
         log_path = os.path.join(self.logs_dir, f"worker-{wid.hex()[:8]}.out")
-        proc = self._spawner.spawn(env, log_path, tpu=bool(msg.get("tpu")))
+        proc = self._spawner.spawn(
+            env,
+            log_path,
+            tpu=bool(msg.get("tpu")),
+            # Even the cold-path Popen failed: tell the head, or its
+            # W_STARTING entry (proc=None for remote spawns) would hold
+            # the startup-cap slot and the claimed task forever.
+            on_fail=lambda w=wid: self._report_spawn_failure(w),
+        )
         with self._lock:
             self._workers[wid.binary()] = proc
+
+    def _report_spawn_failure(self, wid) -> None:
+        try:
+            self.conn.send(
+                {"type": "worker_spawn_failed", "worker_id": wid.binary()}
+            )
+        except ConnectionLost:
+            pass
 
     def _assign_chip_locked(self, wid: bytes):
         """Caller holds self._lock."""

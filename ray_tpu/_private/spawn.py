@@ -31,13 +31,19 @@ class ForkedProc:
     answers. Signal/poll calls briefly wait for that resolution."""
 
     def __init__(self, pid: Optional[int] = None,
-                 on_fail: Optional[callable] = None):
+                 on_fail: Optional[callable] = None,
+                 fallback: Optional[callable] = None):
         self._pid = pid
         self._resolved = threading.Event()
         if pid is not None:
             self._resolved.set()
         self._returncode: Optional[int] = None
         self._on_fail = on_fail
+        # Cold-path escape: () -> Popen. A zygote whose fork() fails
+        # (EAGAIN, rlimit) doesn't doom the worker — the spawn retries
+        # as a direct subprocess before anyone is told of a death.
+        self._fallback = fallback
+        self._popen: Optional[subprocess.Popen] = None
         self._pending_signal: Optional[int] = None
 
     @property
@@ -57,7 +63,23 @@ class ForkedProc:
             except ProcessLookupError:
                 pass
 
-    def _fail(self) -> None:
+    def _fail(self, use_fallback: bool = True) -> None:
+        fallback, self._fallback = self._fallback, None
+        if not use_fallback:
+            # Ambiguous failure (zygote died mid-request): the fork may
+            # have happened and the child may be about to register. A
+            # cold-path respawn here would mint a SECOND process with
+            # the same worker id; let the death path assign a fresh id.
+            fallback = None
+        if fallback is not None:
+            try:
+                child = fallback()
+            except Exception:  # noqa: BLE001 - cold path failed too
+                child = None
+            if child is not None:
+                self._popen = child  # direct child: reap via Popen.poll
+                self._resolve(child.pid)
+                return
         self._returncode = 1
         self._resolved.set()
         if self._on_fail is not None:
@@ -71,6 +93,12 @@ class ForkedProc:
             return self._returncode
         if not self._resolved.is_set():
             return None  # fork still in flight
+        if self._popen is not None:
+            # Cold-path fallback child: a real Popen — poll reaps it.
+            rc = self._popen.poll()
+            if rc is not None:
+                self._returncode = rc
+            return rc
         try:
             os.kill(self._pid, 0)
             return None
@@ -185,7 +213,7 @@ class WorkerSpawner:
                 self._zygote = None
         while True:
             try:
-                awaiting.popleft()._fail()
+                awaiting.popleft()._fail(use_fallback=False)
             except IndexError:
                 break
 
@@ -200,7 +228,15 @@ class WorkerSpawner:
                         env = dict(env)
                         env["RAY_TPU_SPAWNED_AT"] = repr(time.time())
                         req = {"env": env, "log": log_path}
-                        proc = ForkedProc(on_fail=on_fail)
+                        proc = ForkedProc(
+                            on_fail=on_fail,
+                            # fork() failing inside a live zygote
+                            # (EAGAIN, zygote-local rlimit) escapes to a
+                            # direct Popen instead of a worker death.
+                            fallback=lambda e=dict(env): self._cold_spawn(
+                                e, log_path, tpu
+                            ),
+                        )
                         self._awaiting.append(proc)
                         z.stdin.write((json.dumps(req) + "\n").encode())
                         z.stdin.flush()
@@ -215,6 +251,10 @@ class WorkerSpawner:
                         except Exception:  # noqa: BLE001
                             pass
                         self._zygote = None
+        return self._cold_spawn(env, log_path, tpu)
+
+    def _cold_spawn(self, env: Dict[str, str], log_path: str,
+                    tpu: bool) -> subprocess.Popen:
         full_env = dict(os.environ)
         full_env.update(self._base_env)
         full_env.update(env)

@@ -10,7 +10,7 @@ from typing import Any, Dict, List, Optional
 def task_events() -> List[Dict[str, Any]]:
     from .worker import global_client
 
-    reply = global_client().request({"type": "get_task_events"})
+    reply = global_client().state_read({"type": "get_task_events"})
     if not reply.get("ok"):
         raise RuntimeError("get_task_events failed")
     return reply["events"]

@@ -105,6 +105,7 @@ class _DoneBatcher:
     def __init__(self, client: CoreClient):
         self._client = client
         self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
         self._items: list = []
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -124,22 +125,28 @@ class _DoneBatcher:
             self._wake.set()
 
     def flush(self) -> None:
-        with self._lock:
-            items, self._items = self._items, []
-        if not items:
-            return
-        from .protocol import ConnectionLost
+        # _send_lock spans swap AND send: a barrier flush (flush_events
+        # on the reader thread) that loses the swap race to the _loop
+        # thread must not ack until the in-flight task_done_batch is on
+        # the wire, or the GCS would answer a listing before the batch
+        # it was barriering on arrives.
+        with self._send_lock:
+            with self._lock:
+                items, self._items = self._items, []
+            if not items:
+                return
+            from .protocol import ConnectionLost
 
-        try:
-            self._client.send(
-                {
-                    "type": "task_done_batch",
-                    "worker_id": self._client.worker_id.binary(),
-                    "items": items,
-                }
-            )
-        except ConnectionLost:
-            pass
+            try:
+                self._client.send(
+                    {
+                        "type": "task_done_batch",
+                        "worker_id": self._client.worker_id.binary(),
+                        "items": items,
+                    }
+                )
+            except ConnectionLost:
+                pass
 
     def _loop(self) -> None:
         # Park until work arrives — an idle worker must cost ZERO
@@ -754,6 +761,21 @@ class WorkerRuntime:
                 ]
             )
             reply = (OP_REPLY, req_id, error_blob, tuple_results)
+            if not spec.actor_creation:
+                # Direct path: the GCS copy is directory bookkeeping and
+                # can be coalesced — but it must be IN the batcher before
+                # the caller can observe completion, or a flush barrier
+                # (gcs._barrier_flush_events) taken right after the
+                # caller's get() could flush an empty batcher and miss
+                # this record.
+                self._done_batcher.add(
+                    {
+                        "task_id": spec.task_id.binary(),
+                        "name": spec.name,
+                        "results": results,
+                        "error": error_blob,
+                    }
+                )
             try:
                 if lazy:
                     peer.send_lazy(reply)
@@ -762,16 +784,6 @@ class WorkerRuntime:
             except ConnectionLost:
                 pass
         if origin is not None and not spec.actor_creation:
-            # Direct path: the caller already has the result; the GCS
-            # copy is directory bookkeeping and can be coalesced.
-            self._done_batcher.add(
-                {
-                    "task_id": spec.task_id.binary(),
-                    "name": spec.name,
-                    "results": results,
-                    "error": error_blob,
-                }
-            )
             return
         msg = {
             "type": "task_done",
@@ -871,6 +883,24 @@ def main():
 
         if t == "execute_task":
             task_queue.put((msg["spec"], None))
+        elif t == "flush_events":
+            # State-API read barrier (gcs._barrier_flush_events): push
+            # any coalesced task_done records out NOW, then ack. Runs on
+            # the GCS-conn reader thread so it works mid-user-code.
+            rt = rt_holder.get("rt")
+            if rt is not None:
+                try:
+                    rt._done_batcher.flush()
+                except Exception:  # noqa: BLE001
+                    pass
+            bc = rt_holder.get("boot_client")
+            if bc is not None:
+                try:
+                    bc.send(
+                        {"type": "events_flushed", "token": msg.get("token")}
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
         elif t == "dump_stacks":
             # Live profiling hook (reference: dashboard py-spy capture):
             # format every thread's stack right here on the reader
@@ -1044,6 +1074,10 @@ def main():
             pass
     rt = WorkerRuntime(client, task_queue)
     rt_holder["rt"] = rt
+    # State reads issued from inside a task flush our coalesced
+    # task_done records first (the GCS flush barrier excludes the
+    # requesting worker; see CoreClient.state_read).
+    client.pre_state_read_flush = rt._done_batcher.flush
 
     # Make the ray_tpu API usable from inside tasks (nested submission).
     from . import worker as worker_api

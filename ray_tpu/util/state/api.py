@@ -14,7 +14,7 @@ def _list(kind: str, limit: int = 1000,
             raise ValueError(f"unsupported filter op {f[1]!r}")
     # Filters apply server-side BEFORE the limit truncation so matches
     # beyond `limit` aren't silently dropped.
-    reply = global_client().request(
+    reply = global_client().state_read(
         {"type": "list_state", "kind": kind, "limit": limit,
          "filters": [list(f) for f in filters or []]}
     )

@@ -58,6 +58,25 @@ def test_state_api_lists(cluster):
     assert summary["by_func_name"]["f"]["FINISHED"] == 5
 
 
+def test_list_tasks_read_your_writes(cluster):
+    """A list issued immediately after get() must include every task the
+    caller saw finish, even when completions rode the leased-worker
+    direct path whose task_done records are batched (the GCS forces a
+    worker flush barrier before answering — gcs._barrier_flush_events)."""
+    from ray_tpu.util.state import list_tasks
+
+    @ray_tpu.remote
+    def g(x):
+        return x
+
+    done = 0
+    for burst in range(4):
+        ray_tpu.get([g.remote(i) for i in range(8)])
+        done += 8
+        g_tasks = [t for t in list_tasks() if t["name"] == "g"]
+        assert len(g_tasks) == done, f"burst {burst}: {len(g_tasks)}/{done}"
+
+
 def test_timeline_export(cluster, tmp_path):
     @ray_tpu.remote
     def slow():
