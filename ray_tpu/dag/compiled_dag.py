@@ -9,6 +9,7 @@ runs its method, writes its output channel → read output channel.
 """
 from __future__ import annotations
 
+import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -98,6 +99,11 @@ class CompiledDAG:
         self._output_channel: Optional[Channel] = None
         self._loop_refs = []
         self._destroyed = False
+        self._inflight = 0
+        # Guards _inflight for the feeder-thread/collector-thread
+        # pipelining pattern (submit blocks on the bounded channels, so
+        # keeping the pipe full needs a second thread).
+        self._inflight_cv = threading.Condition()
         self._compile()
 
     # ------------------------------------------------------------ compile
@@ -171,19 +177,56 @@ class CompiledDAG:
             self._loop_refs.append(ref)
 
     # ------------------------------------------------------------ execute
-    def execute(self, *input_args) -> Any:
+    def _check_live(self) -> None:
         if self._destroyed:
             raise RuntimeError("CompiledDAG already torn down")
         if getattr(self, "_poisoned", False):
             raise RuntimeError(
-                "CompiledDAG is poisoned: a previous execute() timed out "
+                "CompiledDAG is poisoned: a previous operation timed out "
                 "with a result still in flight (a later read would return "
                 "the stale result). teardown() and re-compile."
             )
+
+    def execute(self, *input_args) -> Any:
+        self.submit(*input_args)
+        return self.collect()
+
+    def submit(self, *input_args) -> None:
+        """Enqueue one input without waiting for its result — the
+        pipelining half of execute() (reference: compiled-DAG
+        execute() returns a future-like ref; here submit/collect split
+        makes the microbatch pipeline explicit). Channels are
+        single-slot, so total in-flight is bounded by the DAG's edge
+        count: a submit into a full pipeline BLOCKS until a stage
+        drains — natural backpressure. Results come out of collect()
+        in submit order."""
+        self._check_live()
         value = input_args[0] if len(input_args) == 1 else input_args
         try:
             for ch in self._input_channels:
                 ch.write(("ok", value), timeout=self._timeout)
+        except TimeoutError:
+            self._poisoned = True
+            raise
+        with self._inflight_cv:
+            self._inflight += 1
+            self._inflight_cv.notify()
+
+    def collect(self) -> Any:
+        """Read the next result in submit (FIFO) order. With a feeder
+        thread submitting concurrently, waits for the next submit to
+        land rather than failing on the race."""
+        self._check_live()
+        with self._inflight_cv:
+            # Grace window covers the feeder-thread race (submit is
+            # microseconds from landing); a genuine collect-with-no-
+            # submit still errors instead of parking self._timeout.
+            if not self._inflight_cv.wait_for(
+                lambda: self._inflight > 0, timeout=1.0
+            ):
+                raise RuntimeError("collect() without a matching submit()")
+            self._inflight -= 1
+        try:
             status, result = self._output_channel.read(timeout=self._timeout)
         except TimeoutError:
             self._poisoned = True

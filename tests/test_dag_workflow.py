@@ -132,6 +132,83 @@ def test_compiled_dag_error_propagation(cluster):
     compiled.teardown()
 
 
+def test_compiled_dag_submit_collect_fifo(cluster):
+    """submit/collect split: results come back in submit order and an
+    error in one microbatch doesn't derail the ones behind it."""
+    @ray_tpu.remote
+    class Working:
+        def apply(self, x):
+            if x == 3:
+                raise ValueError("three")
+            return x * 10
+
+    a = Working.remote()
+    with InputNode() as inp:
+        compiled = a.apply.bind(inp).experimental_compile()
+    for i in range(3):
+        compiled.submit(i)
+    assert compiled.collect() == 0
+    assert compiled.collect() == 10
+    compiled.submit(3)
+    compiled.submit(4)
+    assert compiled.collect() == 20
+    with pytest.raises(ValueError, match="three"):
+        compiled.collect()
+    assert compiled.collect() == 40
+    with pytest.raises(RuntimeError, match="matching submit"):
+        compiled.collect()
+    compiled.teardown()
+
+
+def test_compiled_dag_two_stage_pipeline_overlaps(cluster):
+    """Pipeline parallelism on the compiled-DAG substrate (SURVEY §2.3):
+    with two stages resident in different actor processes, microbatch
+    i+1 runs stage 1 while microbatch i runs stage 2. Stage time is
+    sleep-dominated (emulating device dispatch on a 1-core CI host), so
+    wall clock shows the schedule: sequential = 2*M*T, pipelined ~
+    (M+1)*T. Assert >=1.4x (theory 1.78x at M=8)."""
+    import threading
+
+    T = 0.08
+
+    @ray_tpu.remote
+    class Stage:
+        def apply(self, x):
+            time.sleep(T)
+            return x + 1
+
+    s1, s2 = Stage.remote(), Stage.remote()
+    ray_tpu.get([s1.apply.remote(0), s2.apply.remote(0)])  # warm boot
+    M = 8
+
+    # Sequential oracle: each microbatch traverses both stages alone.
+    t0 = time.perf_counter()
+    for i in range(M):
+        ray_tpu.get(s2.apply.remote(ray_tpu.get(s1.apply.remote(i))))
+    seq_s = time.perf_counter() - t0
+
+    with InputNode() as inp:
+        compiled = s2.apply.bind(s1.apply.bind(inp)).experimental_compile()
+    compiled.execute(0)  # warm the resident loops
+    # Feeder thread keeps the pipe full (submit blocks on the bounded
+    # single-slot channels — that's the backpressure, not a bug).
+    t0 = time.perf_counter()
+    feeder = threading.Thread(
+        target=lambda: [compiled.submit(i) for i in range(M)]
+    )
+    feeder.start()
+    results = [compiled.collect() for _ in range(M)]
+    feeder.join()
+    pipe_s = time.perf_counter() - t0
+    compiled.teardown()
+
+    assert results == [i + 2 for i in range(M)]
+    assert pipe_s * 1.4 < seq_s, (
+        f"pipelined {pipe_s:.3f}s vs sequential {seq_s:.3f}s "
+        f"(speedup {seq_s / pipe_s:.2f}x)"
+    )
+
+
 # -------------------------------------------------------------- workflows
 def test_workflow_run_and_output(cluster, tmp_path):
     workflow.init(str(tmp_path))

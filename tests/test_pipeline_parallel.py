@@ -1,0 +1,140 @@
+"""In-jit pipeline parallelism (parallel/pipeline.py): GPipe-style
+microbatch rotation over a `pipe` mesh axis, validated on the virtual
+8-device CPU mesh (reference rebuild goal: SURVEY.md §2.3 — the
+reference drives PP from the host via compiled DAGs; here the schedule
+lives inside one SPMD program)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from ray_tpu.parallel.pipeline import (
+    pipelined,
+    pipeline_spec,
+    sequential_reference,
+    stack_stage_params,
+)
+
+
+def _mlp_stage(params, x):
+    h = jnp.tanh(x @ params["w"] + params["b"])
+    return h
+
+
+def _make_stage_params(key, n_stages, d):
+    per_stage = []
+    for i in range(n_stages):
+        k1, k2, key = jax.random.split(key, 3)
+        per_stage.append(
+            {
+                "w": jax.random.normal(k1, (d, d)) * 0.3,
+                "b": jax.random.normal(k2, (d,)) * 0.1,
+            }
+        )
+    return per_stage
+
+
+def _pipe_mesh(n):
+    devs = np.array(jax.devices()[:n])
+    return Mesh(devs, ("pipe",))
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(4, 8), (8, 8), (4, 4)])
+def test_pipelined_matches_sequential(n_stages, n_micro):
+    d, mb = 16, 4
+    mesh = _pipe_mesh(n_stages)
+    per_stage = _make_stage_params(jax.random.PRNGKey(0), n_stages, d)
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_micro, mb, d))
+
+    apply = jax.jit(
+        pipelined(_mlp_stage, mesh=mesh, axis="pipe", n_microbatches=n_micro)
+    )
+    p_spec, r_spec = pipeline_spec(mesh)
+    stacked = jax.device_put(stacked, p_spec)
+    x_dev = jax.device_put(x, r_spec)
+
+    got = apply(stacked, x_dev)
+    want = sequential_reference(_mlp_stage, per_stage, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_pipelined_gradients_match_sequential():
+    """jax.grad through the pipeline (transpose of ppermute = reverse
+    ppermute) must equal the unpipelined gradient — the backward
+    schedule falls out of the functional design, no hand-written 1F1B."""
+    n_stages, n_micro, d, mb = 4, 8, 8, 2
+    mesh = _pipe_mesh(n_stages)
+    per_stage = _make_stage_params(jax.random.PRNGKey(2), n_stages, d)
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(3), (n_micro, mb, d))
+
+    apply = pipelined(
+        _mlp_stage, mesh=mesh, axis="pipe", n_microbatches=n_micro
+    )
+
+    def loss_pipelined(params, x):
+        return jnp.mean(apply(params, x) ** 2)
+
+    def loss_sequential(per_stage, x):
+        out = sequential_reference(_mlp_stage, per_stage, x)
+        return jnp.mean(out ** 2)
+
+    p_spec, r_spec = pipeline_spec(mesh)
+    g_pipe = jax.jit(jax.grad(loss_pipelined))(
+        jax.device_put(stacked, p_spec), jax.device_put(x, r_spec)
+    )
+    g_seq = jax.grad(loss_sequential)(per_stage, x)
+    g_seq_stacked = stack_stage_params(g_seq)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g_pipe,
+        g_seq_stacked,
+    )
+
+
+def test_pipelined_remat_matches():
+    n_stages, n_micro, d, mb = 4, 4, 8, 2
+    mesh = _pipe_mesh(n_stages)
+    per_stage = _make_stage_params(jax.random.PRNGKey(4), n_stages, d)
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(5), (n_micro, mb, d))
+    p_spec, r_spec = pipeline_spec(mesh)
+    stacked_dev = jax.device_put(stacked, p_spec)
+    x_dev = jax.device_put(x, r_spec)
+
+    plain = pipelined(_mlp_stage, mesh=mesh, n_microbatches=n_micro)
+    remat = pipelined(
+        _mlp_stage, mesh=mesh, n_microbatches=n_micro, remat=True
+    )
+
+    def loss(f):
+        return jax.jit(
+            jax.grad(lambda p, x: jnp.mean(f(p, x) ** 2))
+        )(stacked_dev, x_dev)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        loss(plain),
+        loss(remat),
+    )
+
+
+def test_pipelined_wrong_microbatch_count_raises():
+    mesh = _pipe_mesh(4)
+    per_stage = _make_stage_params(jax.random.PRNGKey(6), 4, 8)
+    stacked = stack_stage_params(per_stage)
+    apply = pipelined(_mlp_stage, mesh=mesh, n_microbatches=8)
+    with pytest.raises(ValueError, match="microbatch"):
+        apply(stacked, jnp.zeros((4, 2, 8)))
+
+
+def test_pipelined_rejects_missing_axis():
+    mesh = _pipe_mesh(4)
+    with pytest.raises(ValueError, match="no axis"):
+        pipelined(_mlp_stage, mesh=mesh, axis="nope", n_microbatches=4)
