@@ -27,6 +27,12 @@ _DEFAULTS: Dict[str, Any] = {
     # thrashing small hosts (boot latency grew 0.5s -> 4.4s in the
     # storm profile). 0 = auto (max(4, cpu count)).
     "max_starting_workers_per_node": 0,
+    # Sub-core actors (0 < num_cpus < 1, default env, serial) pack many
+    # per worker process instead of paying a ~300ms interpreter boot
+    # each: declaring "this actor needs 1% of a core" opts into dense
+    # co-hosting. Actors with default resources (num_cpus=0) keep a
+    # dedicated process (reference process-per-actor isolation).
+    "max_actors_per_worker": 64,
     "worker_register_timeout_s": 30.0,
     "worker_idle_timeout_s": 300.0,
     # Health checking (reference: gcs_health_check_manager.h).

@@ -32,7 +32,7 @@ import subprocess
 import sys
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from multiprocessing.connection import Listener
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -114,6 +114,12 @@ class WorkerHandle:
     # Direct actor-call socket served by the worker process (reference:
     # actor calls bypass raylets — direct_actor_task_submitter.h).
     direct_addr: str = ""
+    # Shared actor host: packs many sub-core actors into one process
+    # (see RayConfig.max_actors_per_worker). `packed` maps hosted
+    # actor id -> its creation spec (for per-actor resource release and
+    # restart bookkeeping on host death).
+    actor_host: bool = False
+    packed: Dict[bytes, TaskSpec] = field(default_factory=dict)
     # Resources held while leased to a client (direct task transport).
     lease_resources: Optional[Dict[str, float]] = None
 
@@ -140,6 +146,9 @@ class NodeState:
     alive: bool = True
     # Fungible (non-actor) worker ids on this node.
     pool: Set[bytes] = field(default_factory=set)
+    # Shared actor hosts on this node (worker ids with actor_host=True):
+    # packable creations scan this, not the cluster worker table.
+    actor_hosts: Set[bytes] = field(default_factory=set)
     label: str = ""
     # Multi-host: the node daemon's control connection (None for the head
     # node and for virtual nodes, whose workers the GCS spawns directly),
@@ -178,6 +187,66 @@ class PlacementGroupState:
     state: str = "PENDING"  # PENDING | CREATED | REMOVED
     name: str = ""
     waiters: List[Tuple[PeerConn, int]] = field(default_factory=list)
+
+
+class _PendingQueue:
+    """Pending tasks bucketed by scheduling class (reference:
+    cluster_task_manager's per-SchedulingClass queues,
+    scheduling_class_util.h). The head-scaling property: placement
+    feasibility for a *plain* task (no PG, no strategy) depends only on
+    its resource shape, so when the head of a class queue can't place,
+    the whole class is blocked — one O(nodes) scan per class per pass
+    instead of per task. A 200k-deep queue over 1k nodes costs
+    O(classes + grants) per pass, not O(200k x 1k).
+
+    Tasks with placement groups or scheduling strategies keep per-task
+    placement state and go to the `special` queue (scanned fully, like
+    the old single-deque pass — these are rare relative to bulk task
+    fans)."""
+
+    __slots__ = ("classes", "special")
+
+    def __init__(self):
+        # key -> deque; key = (resource shape, actor_creation) — the
+        # creation flag changes pool-growth rules (_schedule_once).
+        self.classes: "OrderedDict[Any, deque]" = OrderedDict()
+        self.special: deque = deque()
+
+    @staticmethod
+    def _key(spec: TaskSpec):
+        if (
+            spec.placement_group_id is not None
+            or spec.scheduling_strategy is not None
+        ):
+            return None
+        return (spec.scheduling_class(), spec.actor_creation)
+
+    def append(self, spec: TaskSpec) -> None:
+        key = self._key(spec)
+        if key is None:
+            self.special.append(spec)
+        else:
+            q = self.classes.get(key)
+            if q is None:
+                q = self.classes[key] = deque()
+            q.append(spec)
+
+    def extend(self, specs) -> None:
+        for s in specs:
+            self.append(s)
+
+    def __len__(self) -> int:
+        return len(self.special) + sum(
+            len(q) for q in self.classes.values()
+        )
+
+    def __bool__(self) -> bool:
+        return bool(self.special) or bool(self.classes)
+
+    def __iter__(self):
+        yield from self.special
+        for q in self.classes.values():
+            yield from q
 
 
 class _Unschedulable(Exception):
@@ -224,7 +293,7 @@ class GcsServer:
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.nodes: Dict[bytes, NodeState] = {}
         self.placement_groups: Dict[bytes, PlacementGroupState] = {}
-        self._pending: deque[TaskSpec] = deque()
+        self._pending = _PendingQueue()
         # Per-task state transitions for the state API, `ray_tpu
         # timeline` (chrome://tracing) and the dashboard equivalent
         # (reference: GcsTaskManager task-event store,
@@ -790,7 +859,11 @@ class GcsServer:
         )
         if w is not None:
             if w.state == W_BUSY:
-                w.state = W_ACTOR if w.actor_id is not None else W_IDLE
+                w.state = (
+                    W_ACTOR
+                    if (w.actor_id is not None or w.packed)
+                    else W_IDLE
+                )
                 if w.current_task is not None:
                     # Actors hold their creation resources for their
                     # lifetime (released on death), unless creation failed.
@@ -857,7 +930,10 @@ class GcsServer:
             actor.worker_id = WorkerID(wid)
             if w is not None:
                 w.state = W_ACTOR
-                w.actor_id = actor.actor_id
+                if w.actor_host:
+                    w.packed[aid] = actor.spec
+                else:
+                    w.actor_id = actor.actor_id
                 node = self.nodes[w.node_id.binary()]
                 node.pool.discard(wid)  # no longer fungible
             while actor.pending:
@@ -878,6 +954,17 @@ class GcsServer:
                     actor.pending.popleft(), None, actor_error=actor.death_reason
                 )
             self._notify_direct_waiters(actor)
+            if w is not None and w.state != W_DEAD and w.actor_host:
+                # Shared host: the failed creation's resources were
+                # acquired at scheduling and (unlike the dedicated path)
+                # never released through current_task bookkeeping. The
+                # host itself survives — co-hosted actors keep running,
+                # and a host left EMPTY by the failure re-pools (a
+                # stranded warm interpreter would otherwise idle forever
+                # while plain tasks boot fresh workers).
+                self._release_task_resources(actor.spec, w.node_id)
+                self._maybe_repool_host(w)
+                return
             # The worker that failed construction is pinned but useless; let
             # it exit rather than leak one process per failed creation.
             if w is not None and w.state != W_DEAD:
@@ -1308,6 +1395,26 @@ class GcsServer:
         if actor.worker_id is not None:
             wid = actor.worker_id.binary()
             w = self.workers.get(wid)
+            if w is not None and w.state != W_DEAD and aid in w.packed:
+                # Packed actor on a shared host: terminate JUST this
+                # actor — co-hosted actors keep running. In-flight calls
+                # for it fail fast; an emptied host returns to the
+                # fungible pool as a warm prestarted worker.
+                self._release_task_resources(actor.spec, w.node_id)
+                w.packed.pop(aid, None)
+                for tid, s in list(w.inflight.items()):
+                    if s.actor_id is not None and s.actor_id.binary() == aid:
+                        w.inflight.pop(tid)
+                        self._fail_task_returns(s, None, actor_error=reason)
+                if w.conn is not None:
+                    try:
+                        w.conn.send(
+                            {"type": "terminate_actor", "actor_id": aid}
+                        )
+                    except ConnectionLost:
+                        pass
+                self._maybe_repool_host(w)
+                return
             if w is not None and w.state != W_DEAD:
                 # Creation-lifetime resources: the death handler's actor
                 # branch skips them for already-A_DEAD actors.
@@ -2842,105 +2949,215 @@ class GcsServer:
                 self._version += 1
                 self._table_versions["placement_groups"] += 1
                 progressed = True
-        requeue: List[TaskSpec] = []
-        # Each task that found resources but no worker claims one starting
-        # worker of its kind; we only spawn when claims exceed workers
+        # Each task that found resources but no worker claims starting
+        # workers of its kind; we only spawn when claims exceed workers
         # already starting (reference: worker_pool.cc PopWorker ->
         # StartWorkerProcess). Keyed by (node, needs_tpu).
         claims: Dict[Tuple[bytes, bool], int] = {}
-        while self._pending:
-            spec = self._pending.popleft()
-            if not self._deps_ready(spec):
-                requeue.append(spec)
-                continue
-            try:
-                node = self._pick_node(spec)
-            except _Unschedulable as e:
-                from ..exceptions import (
-                    PlacementGroupSchedulingError,
-                    TaskUnschedulableError,
-                )
-
-                exc_cls = (
-                    PlacementGroupSchedulingError
-                    if spec.placement_group_id is not None
-                    else TaskUnschedulableError
-                )
-                self._fail_task_returns(spec, exc_cls(str(e)))
-                self._version += 1  # FAILED returns are durable state
-                for _t in ("objects", "pending", "actors"):
-                    self._table_versions[_t] += 1
+        # Special queue (PG-pinned / strategy tasks): placement is
+        # per-task state, scan them all.
+        special_requeue: List[TaskSpec] = []
+        for _ in range(len(self._pending.special)):
+            spec = self._pending.special.popleft()
+            outcome = self._try_place(spec, claims)
+            if outcome in ("dispatched", "unschedulable"):
                 progressed = True
+            else:
+                special_requeue.append(spec)
+        self._pending.special.extend(special_requeue)
+        # Class queues: placement feasibility is a function of the
+        # resource shape alone, so the first task that can't place
+        # blocks its whole class — one O(nodes) probe per class per
+        # pass keeps a 200k-deep queue over 1k nodes cheap
+        # (_PendingQueue docstring).
+        for key in list(self._pending.classes.keys()):
+            q = self._pending.classes.get(key)
+            if q is None:
                 continue
-            if node is None:
-                requeue.append(spec)
-                continue
-            worker = self._pick_worker(node, spec)
-            if worker is None:
-                # resources were acquired in _pick_node; give them back and
-                # retry once a worker registers.
-                self._release_task_resources(spec, node.node_id)
-                requeue.append(spec)
-                needs_tpu = spec.resources.get("TPU", 0) > 0
-                nid = (node.node_id.binary(), needs_tpu)
-                claims[nid] = claims.get(nid, 0) + 1
-                # Pool accounting is per worker kind: TPU workers are gated
-                # by TPU resource accounting, CPU workers by core count.
-                starting = sum(
-                    1
-                    for w in self.workers.values()
-                    if w.node_id == node.node_id
-                    and w.state == W_STARTING
-                    and w.tpu == needs_tpu
+            deferred: List[TaskSpec] = []
+            dispatched_any = False
+            for _ in range(len(q)):
+                spec = q.popleft()
+                outcome = self._try_place(
+                    spec, claims, backlog=len(q)
                 )
-                pool_same_kind = sum(
-                    1
-                    for wid in node.pool
-                    if (w := self.workers.get(wid)) is not None
-                    and w.tpu == needs_tpu
-                )
-                can_grow = (
-                    spec.actor_creation
-                    or needs_tpu
-                    or pool_same_kind + starting
-                    < max(int(node.total.get("CPU", 1)), 1)
-                )
-                # Admission control: never boot more interpreters at
-                # once than the host can actually run — queued claims
-                # re-spawn as registrations complete (each hello wakes
-                # the scheduler), so a storm drains at the boot rate
-                # instead of thrashing (reference: worker_pool.cc
-                # maximum_startup_concurrency).
-                cap = RayConfig.max_starting_workers_per_node or max(
-                    4, int(node.total.get("CPU", 1))
-                )
-                if starting < claims[nid] and can_grow and starting < cap:
-                    self._spawn_worker(node, tpu=needs_tpu)
-                continue
+                if outcome in ("dispatched", "unschedulable"):
+                    progressed = True
+                    dispatched_any = dispatched_any or outcome == "dispatched"
+                elif outcome == "deferred":
+                    deferred.append(spec)  # deps pending: skip, keep going
+                else:  # no capacity / no worker: class blocked this pass
+                    q.appendleft(spec)
+                    break
+            q.extend(deferred)
+            if not q:
+                self._pending.classes.pop(key, None)
+            elif dispatched_any:
+                # Round-robin fairness: a class that consumed capacity
+                # this pass goes to the back so a saturated cluster
+                # can't let one class starve the ones probed after it
+                # (the old global FIFO's arrival-order property).
+                self._pending.classes.move_to_end(key)
+        return progressed
+
+    def _try_place(self, spec: TaskSpec, claims: Dict[Tuple[bytes, bool], int],
+                   backlog: int = 0) -> str:
+        """Attempt to place one pending task. Returns "dispatched",
+        "unschedulable" (terminal failure recorded), "deferred" (deps
+        not ready), or "blocked" (no capacity / no idle worker yet —
+        spawn claims recorded). Caller holds the lock."""
+        if not self._deps_ready(spec):
+            return "deferred"
+        try:
+            node = self._pick_node(spec)
+        except _Unschedulable as e:
+            from ..exceptions import (
+                PlacementGroupSchedulingError,
+                TaskUnschedulableError,
+            )
+
+            exc_cls = (
+                PlacementGroupSchedulingError
+                if spec.placement_group_id is not None
+                else TaskUnschedulableError
+            )
+            self._fail_task_returns(spec, exc_cls(str(e)))
+            self._version += 1  # FAILED returns are durable state
+            for _t in ("objects", "pending", "actors"):
+                self._table_versions[_t] += 1
+            return "unschedulable"
+        if node is None:
+            return "blocked"
+        worker = self._pick_worker(node, spec)
+        if worker is None:
+            # resources were acquired in _pick_node; give them back and
+            # retry once a worker registers.
+            self._release_task_resources(spec, node.node_id)
+            needs_tpu = spec.resources.get("TPU", 0) > 0
+            nid = (node.node_id.binary(), needs_tpu)
+            # This probe stands for the whole blocked class behind it:
+            # claim enough boots to cover the backlog (the admission cap
+            # still bounds concurrent boots).
+            claims[nid] = claims.get(nid, 0) + 1 + backlog
+            # Pool accounting is per worker kind: TPU workers are gated
+            # by TPU resource accounting, CPU workers by core count.
+            starting = sum(
+                1
+                for w in self.workers.values()
+                if w.node_id == node.node_id
+                and w.state == W_STARTING
+                and w.tpu == needs_tpu
+            )
+            pool_same_kind = sum(
+                1
+                for wid in node.pool
+                if (w := self.workers.get(wid)) is not None
+                and w.tpu == needs_tpu
+            )
+            can_grow = (
+                spec.actor_creation
+                or needs_tpu
+                or pool_same_kind + starting
+                < max(int(node.total.get("CPU", 1)), 1)
+            )
+            # Admission control: never boot more interpreters at
+            # once than the host can actually run — queued claims
+            # re-spawn as registrations complete (each hello wakes
+            # the scheduler), so a storm drains at the boot rate
+            # instead of thrashing (reference: worker_pool.cc
+            # maximum_startup_concurrency).
+            cap = RayConfig.max_starting_workers_per_node or max(
+                4, int(node.total.get("CPU", 1))
+            )
+            while starting < claims[nid] and can_grow and starting < cap:
+                self._spawn_worker(node, tpu=needs_tpu)
+                starting += 1
+                if not (spec.actor_creation or needs_tpu):
+                    can_grow = pool_same_kind + starting < max(
+                        int(node.total.get("CPU", 1)), 1
+                    )
+            return "blocked"
+        host_packed = worker.actor_host and spec.actor_creation
+        if host_packed:
+            # Shared host: it may be serving other actors right now —
+            # no W_BUSY/current_task claim (that machinery assumes
+            # one task at a time); inflight alone carries the spec,
+            # like _route_actor_task's method dispatch.
+            worker.inflight[spec.task_id.binary()] = spec
+        else:
             worker.state = W_BUSY
             worker.current_task = spec
             worker.task_started_at = time.time()
             worker.inflight[spec.task_id.binary()] = spec
             if spec.actor_creation:
                 worker.actor_id = spec.actor_id
-            try:
-                worker.conn.send({"type": "execute_task", "spec": spec})
-                self._record_task_event(
-                    spec.task_id.binary(), spec.name, "RUNNING",
-                    worker.worker_id.binary(),
-                )
-                progressed = True
-            except ConnectionLost:
-                self._release_task_resources(spec, node.node_id)
-                requeue.append(spec)
-                self._handle_worker_death(
-                    worker.worker_id.binary(), "send failed", respawn=True
-                )
-        self._pending.extend(requeue)
-        return progressed
+        try:
+            msg_out = {"type": "execute_task", "spec": spec}
+            if host_packed:
+                msg_out["packed"] = True
+            worker.conn.send(msg_out)
+            self._record_task_event(
+                spec.task_id.binary(), spec.name, "RUNNING",
+                worker.worker_id.binary(),
+            )
+            return "dispatched"
+        except ConnectionLost:
+            self._release_task_resources(spec, node.node_id)
+            self._pending.append(spec)
+            self._handle_worker_death(
+                worker.worker_id.binary(), "send failed", respawn=True
+            )
+            return "unschedulable"
+
+    @staticmethod
+    def _packable(spec: TaskSpec) -> bool:
+        """Sub-core, default-environment, serial actors co-host many per
+        process (opt-in by declaring 0 < num_cpus < 1). Everything else
+        keeps the reference's process-per-actor isolation — including
+        default actors (num_cpus=0), whose authors never said sharing a
+        process was acceptable."""
+        return (
+            spec.actor_creation
+            and RayConfig.max_actors_per_worker > 1
+            and set(spec.resources) <= {"CPU"}
+            and 0 < spec.resources.get("CPU", 0) < 1
+            and spec.max_concurrency == 1
+            and not spec.concurrency_groups
+            and spec.runtime_env is None
+            and spec.placement_group_id is None
+        )
 
     def _pick_worker(self, node: NodeState, spec: TaskSpec) -> Optional[WorkerHandle]:
         needs_tpu = spec.resources.get("TPU", 0) > 0
+        if not needs_tpu and self._packable(spec):
+            # Existing shared host with a free slot first; else convert
+            # an idle worker into a host (it leaves the fungible pool).
+            cap = RayConfig.max_actors_per_worker
+            for wid in list(node.actor_hosts):
+                w = self.workers.get(wid)
+                if w is None or w.state == W_DEAD or not w.actor_host:
+                    node.actor_hosts.discard(wid)
+                    continue
+                if (
+                    w.conn is not None
+                    and len(w.packed) + sum(
+                        1 for s in w.inflight.values() if s.actor_creation
+                    ) < cap
+                ):
+                    return w
+            for wid in list(node.pool):
+                w = self.workers.get(wid)
+                if (
+                    w is not None
+                    and w.state == W_IDLE
+                    and w.conn is not None
+                    and not w.tpu
+                ):
+                    node.pool.discard(wid)
+                    w.actor_host = True
+                    node.actor_hosts.add(wid)
+                    return w
+            return None
         for wid in list(node.pool):
             w = self.workers.get(wid)
             if (
@@ -2992,6 +3209,22 @@ class GcsServer:
         )
         return w
 
+    def _maybe_repool_host(self, w: WorkerHandle) -> None:
+        """An emptied shared host (no packed actors, no in-flight
+        creations) rejoins the fungible pool as a warm prestarted
+        worker. Caller holds the lock."""
+        if w.state == W_DEAD or not w.actor_host:
+            return
+        if w.packed or any(s.actor_creation for s in w.inflight.values()):
+            return
+        w.actor_host = False
+        w.state = W_IDLE
+        node = self.nodes.get(w.node_id.binary())
+        if node is not None:
+            node.actor_hosts.discard(w.worker_id.binary())
+            node.pool.add(w.worker_id.binary())
+        self._work.notify_all()
+
     def _h_worker_spawn_failed(self, state, msg):
         """A remote raylet could not start a head-requested worker (both
         the zygote fork and the cold-path Popen failed): release the
@@ -3023,6 +3256,7 @@ class GcsServer:
             node = self.nodes.get(w.node_id.binary())
             if node is not None:
                 node.pool.discard(wid)
+                node.actor_hosts.discard(wid)
             dying_task = w.current_task
             if dying_task is not None:
                 self._release_task_resources(dying_task, w.node_id)
@@ -3046,13 +3280,34 @@ class GcsServer:
                     self._fail_task_returns(
                         spec, exc_cls(f"worker died: {reason}")
                     )
+            # Every actor this process hosted dies with it: the dedicated
+            # actor (actor_id), every packed actor on a shared host, and
+            # any packed creation still in flight (its resources were
+            # acquired at scheduling but never entered `packed`).
+            dead_actor_ids: List[Tuple[bytes, bool]] = []
             if w.actor_id is not None:
-                actor = self.actors.get(w.actor_id.binary())
+                dead_actor_ids.append((w.actor_id.binary(), False))
+            for aid_b in w.packed:
+                dead_actor_ids.append((aid_b, True))
+            for spec in inflight.values():
+                if (
+                    spec.actor_creation
+                    and spec.actor_id is not None
+                    and spec.actor_id.binary() not in w.packed
+                    and (
+                        w.actor_id is None
+                        or spec.actor_id.binary() != w.actor_id.binary()
+                    )
+                ):
+                    dead_actor_ids.append((spec.actor_id.binary(), True))
+            w.packed = {}
+            for aid_b, release_always in dead_actor_ids:
+                actor = self.actors.get(aid_b)
                 if actor is not None and actor.state not in (A_DEAD, A_RESTARTING):
                     released_creation = (
                         dying_task is not None and dying_task.actor_creation
                     )
-                    if prev_state == W_ACTOR or (
+                    if release_always or prev_state == W_ACTOR or (
                         prev_state == W_BUSY and not released_creation
                     ):
                         # Lifetime resources held since creation. W_BUSY

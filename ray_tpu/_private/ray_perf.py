@@ -278,6 +278,30 @@ def bench_scale():
     RESULTS["scale_50k_queued_tasks_per_s"] = round(rate, 1)
     print(f"scale_50k_queued_tasks_per_s: {rate:,.0f} /s")
 
+    # Reference-envelope shape (release/benchmarks/README.md: 2k nodes,
+    # 1M queued): 1k virtual nodes in the tables + 200k queued tasks.
+    # The nodes carry no usable capacity, so every task scans past them
+    # — per-class pending queues keep that O(classes) per pass.
+    cl = Cluster(initialize_head=False)
+    t0 = time.perf_counter()
+    for i in range(1000):
+        cl.add_node(resources={"CPU": 0.001}, label=f"s{i}")
+    rate = 1000 / (time.perf_counter() - t0)
+    RESULTS["scale_1k_node_registrations_per_s"] = round(rate, 1)
+    print(f"scale_1k_node_registrations_per_s: {rate:,.0f} /s")
+
+    n = 200_000
+    t0 = time.perf_counter()
+    refs = [unit.remote(i) for i in range(n)]
+    ray_tpu.get(refs, timeout=1800)
+    rate = n / (time.perf_counter() - t0)
+    RESULTS["scale_200k_tasks_1k_nodes_per_s"] = round(rate, 1)
+    print(f"scale_200k_tasks_1k_nodes_per_s: {rate:,.0f} /s")
+    # Deregister the virtual fleet: later benches must measure the
+    # normal-size cluster, not scan 1k ghost nodes.
+    for node in list(cl._nodes):
+        cl.remove_node(node)
+
     # many_actors: creation + first-call rate (fork-server spawn path).
     @ray_tpu.remote(num_cpus=0.01)
     class Cell:

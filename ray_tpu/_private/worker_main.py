@@ -45,7 +45,7 @@ from .config import RayConfig
 from .ids import ActorID, TaskID, WorkerID
 from .protocol import OP_CALL, OP_REPLY
 from .task_spec import TaskSpec
-from ..exceptions import RayTaskError
+from ..exceptions import RayActorError, RayTaskError
 from ..object_ref import ObjectRef
 
 
@@ -173,8 +173,17 @@ class WorkerRuntime:
         self.client = client
         self.task_queue = task_queue
         self.fn_cache: Dict[bytes, Any] = {}
-        self.actor_instance: Any = None
-        self.actor_id: Optional[bytes] = None
+        # aid -> instance. One entry for a dedicated actor worker; many
+        # for a shared host packing sub-core actors (the GCS routes
+        # packable creations here — gcs._packable). Each actor gets its
+        # own execution lock so co-hosted actors stay mutually
+        # concurrent (and same-host nested calls can't deadlock) while
+        # each actor alone stays serial.
+        self.actors: Dict[bytes, Any] = {}
+        self._actor_locks: Dict[bytes, threading.RLock] = {}
+        # Set when a creation arrives marked packed: shared hosts stay
+        # alive when their last actor exits (the GCS re-pools them).
+        self._shared_host = False
         self.max_concurrency = 1
         self._pool: Optional[ThreadPoolExecutor] = None
         self._group_pools: Dict[str, ThreadPoolExecutor] = {}
@@ -189,6 +198,24 @@ class WorkerRuntime:
         # delivered it.
         self._exec_lock = threading.RLock()
 
+    def _actor_for(self, aid: Optional[bytes]):
+        inst = self.actors.get(aid) if aid is not None else None
+        if inst is None:
+            raise RayActorError(
+                "actor is gone: killed, exited, or never created on this "
+                "worker"
+            )
+        return inst
+
+    def _lock_for(self, aid: Optional[bytes]):
+        """Per-actor serial execution; everything else (plain tasks,
+        creations) serializes on the worker-wide lock."""
+        if aid is not None:
+            lk = self._actor_locks.get(aid)
+            if lk is not None:
+                return lk
+        return self._exec_lock
+
     def handle_fast_call(self, frame, peer) -> None:
         """An OP_CALL frame from a direct connection.
 
@@ -198,8 +225,9 @@ class WorkerRuntime:
         async actors keep their pool/event-loop dispatch."""
         req_id = frame[1]
         method_name = frame[4]
-        if self.actor_instance is not None and frame[7] is not None:
-            method = getattr(self.actor_instance, method_name, None)
+        _inst = self.actors.get(frame[7]) if frame[7] is not None else None
+        if _inst is not None:
+            method = getattr(_inst, method_name, None)
             if method is not None and asyncio.iscoroutinefunction(method):
                 self._submit_async(_spec_from_frame(frame), (peer, req_id, False))
                 return
@@ -219,7 +247,7 @@ class WorkerRuntime:
                 return
         if method_name in ("__ray_terminate__", "__ray_apply__"):
             spec = _spec_from_frame(frame)
-            with self._exec_lock:
+            with self._lock_for(frame[7]):
                 # lazy reply: the reader thread flushes once input drains.
                 self._execute(spec, (peer, req_id, True))
             return
@@ -227,7 +255,7 @@ class WorkerRuntime:
 
         if tracing.enabled():
             spec = _spec_from_frame(frame)
-            with self._exec_lock:
+            with self._lock_for(frame[7]):
                 self._execute(spec, (peer, req_id, True))
             return
         self._execute_inline(frame, peer)
@@ -242,10 +270,10 @@ class WorkerRuntime:
 
         _, req_id, tid, fid, method, args_blob, nret, aid = frame[:8]
         name = method or "task"
-        with self._exec_lock:
+        with self._lock_for(aid):
             try:
                 if aid is not None:
-                    fn = getattr(self.actor_instance, method)
+                    fn = getattr(self._actor_for(aid), method)
                 else:
                     fn = self.fn_cache.get(fid)
                     if fn is None:
@@ -273,7 +301,7 @@ class WorkerRuntime:
         tuple_results = None
         dict_results = []
         if exc is not None:
-            if not isinstance(exc, RayTaskError):
+            if not isinstance(exc, (RayTaskError, RayActorError)):
                 exc = RayTaskError.from_exception(name, exc)
             try:
                 error_blob = serialization.pack(exc)
@@ -308,7 +336,10 @@ class WorkerRuntime:
                             d.get("inline"),
                             d.get("segment"),
                             d.get("size", 0),
-                            d.get("children"),
+                            # () not None: None used to push the whole
+                            # reply onto the pickle fallback (fastpath
+                            # enc_reply rejected it).
+                            d.get("children") or (),
                         )
                     )
                     dict_results.append(d)
@@ -370,8 +401,11 @@ class WorkerRuntime:
                 self._actor_env.__enter__()
             args, kwargs = self._resolve_args(spec)
             cls = self._resolve_function(spec)
-            self.actor_instance = cls(*args, **kwargs)
-            self.actor_id = spec.actor_id.binary()
+            aid_b = spec.actor_id.binary()
+            self.actors[aid_b] = cls(*args, **kwargs)
+            self._actor_locks[aid_b] = threading.RLock()
+            if getattr(spec, "packed_host", False):
+                self._shared_host = True
             self.max_concurrency = spec.max_concurrency
             if spec.concurrency_groups:
                 # Named concurrency groups (reference:
@@ -411,12 +445,19 @@ class WorkerRuntime:
             if spec.method_name == "__ray_terminate__":
                 # Ordering: completions queued behind us must reach the
                 # GCS before the exit notice tears down worker state.
+                aid_b = spec.actor_id.binary()
+                self.actors.pop(aid_b, None)
+                self._actor_locks.pop(aid_b, None)
                 self._done_batcher.flush()
                 self.client.send(
-                    {"type": "actor_exit", "actor_id": spec.actor_id.binary()}
+                    {"type": "actor_exit", "actor_id": aid_b}
                 )
-                self._done.set()
-                self.task_queue.put((None, None))
+                if not self._shared_host:
+                    # Dedicated actor worker: process dies with its
+                    # actor. Shared hosts outlive any one actor — the
+                    # GCS re-pools an empty host.
+                    self._done.set()
+                    self.task_queue.put((None, None))
                 return None
             args, kwargs = self._resolve_args(spec)
             if spec.method_name == "__ray_apply__":
@@ -424,8 +465,13 @@ class WorkerRuntime:
                 # (compiled-graph loops, introspection) — the function
                 # runs with actor state but isn't a class method.
                 fn = cloudpickle.loads(args[0])
-                return fn(self.actor_instance, *args[1:], **kwargs)
-            method = getattr(self.actor_instance, spec.method_name)
+                return fn(
+                    self._actor_for(spec.actor_id.binary()),
+                    *args[1:], **kwargs,
+                )
+            method = getattr(
+                self._actor_for(spec.actor_id.binary()), spec.method_name
+            )
             from ..util import tracing
 
             if tracing.enabled():
@@ -479,7 +525,9 @@ class WorkerRuntime:
                 # Resolve inside the coroutine: a failed dependency must
                 # fail this call, not the dispatch thread.
                 args, kwargs = self._resolve_args(spec)
-                method = getattr(self.actor_instance, spec.method_name)
+                method = getattr(
+                    self._actor_for(spec.actor_id.binary()), spec.method_name
+                )
                 async for item in method(*args, **kwargs):
                     fields = self._seal_value(
                         tid[:12] + idx.to_bytes(4, "little"), item
@@ -498,9 +546,9 @@ class WorkerRuntime:
                 exc = e
             error_blob = None
             if exc is not None:
-                e2 = exc if isinstance(exc, RayTaskError) else (
-                    RayTaskError.from_exception(spec.name, exc)
-                )
+                e2 = exc if isinstance(
+                    exc, (RayTaskError, RayActorError)
+                ) else RayTaskError.from_exception(spec.name, exc)
                 try:
                     error_blob = serialization.pack(e2)
                 except Exception:
@@ -560,7 +608,9 @@ class WorkerRuntime:
 
         async def runner():
             args, kwargs = self._resolve_args(spec)
-            method = getattr(self.actor_instance, spec.method_name)
+            method = getattr(
+                self._actor_for(spec.actor_id.binary()), spec.method_name
+            )
             if group is not None and group in limits:
                 sem = self._group_sems.get(group)
                 if sem is None:
@@ -643,7 +693,7 @@ class WorkerRuntime:
             exc = e
         error_blob = None
         if exc is not None:
-            if not isinstance(exc, RayTaskError):
+            if not isinstance(exc, (RayTaskError, RayActorError)):
                 exc = RayTaskError.from_exception(spec.name, exc)
             try:
                 error_blob = serialization.pack(exc)
@@ -694,7 +744,7 @@ class WorkerRuntime:
         results = [{"object_id": oid.binary()} for oid in return_ids]
         error_blob = None
         if exc is not None:
-            if not isinstance(exc, RayTaskError):
+            if not isinstance(exc, (RayTaskError, RayActorError)):
                 exc = RayTaskError.from_exception(spec.name, exc)
             try:
                 error_blob = serialization.pack(exc)
@@ -755,7 +805,7 @@ class WorkerRuntime:
                         r.get("inline"),
                         r.get("segment"),
                         r.get("size", 0),
-                        r.get("children"),
+                        r.get("children") or (),
                     )
                     for r in results
                 ]
@@ -822,7 +872,11 @@ class WorkerRuntime:
                 break
             is_actor_method = spec.actor_id is not None and not spec.actor_creation
             if is_actor_method and spec.method_name != "__ray_terminate__":
-                method = getattr(self.actor_instance, spec.method_name, None)
+                method = getattr(
+                    self.actors.get(spec.actor_id.binary()),
+                    spec.method_name,
+                    None,
+                )
                 if method is not None and asyncio.iscoroutinefunction(method):
                     self._submit_async(spec, origin)
                     continue
@@ -845,7 +899,11 @@ class WorkerRuntime:
                 if pool is not None:
                     pool.submit(self._execute, spec, origin)
                     continue
-            with self._exec_lock:
+            with self._lock_for(
+                spec.actor_id.binary()
+                if spec.actor_id is not None and not spec.actor_creation
+                else None
+            ):
                 self._execute(spec, origin)
 
 
@@ -882,7 +940,23 @@ def main():
                 pass
 
         if t == "execute_task":
-            task_queue.put((msg["spec"], None))
+            s = msg["spec"]
+            if msg.get("packed"):
+                # Creation routed to a shared actor host (gcs._packable):
+                # the runtime packs the instance and the process outlives
+                # any single actor.
+                s.packed_host = True
+            task_queue.put((s, None))
+        elif t == "terminate_actor":
+            # Force-kill of ONE packed actor on a shared host (the
+            # process-level SIGKILL of a dedicated actor worker doesn't
+            # apply — co-hosted actors must survive). Dropping the
+            # instance makes in-flight and future calls fail fast.
+            rt = rt_holder.get("rt")
+            if rt is not None:
+                aid = msg.get("actor_id")
+                rt.actors.pop(aid, None)
+                rt._actor_locks.pop(aid, None)
         elif t == "flush_events":
             # State-API read barrier (gcs._barrier_flush_events): push
             # any coalesced task_done records out NOW, then ack. Runs on

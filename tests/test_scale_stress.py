@@ -46,6 +46,36 @@ def test_many_virtual_nodes_register_and_list(head):
     assert dt < 4.0, f"120 node registrations took {dt:.1f}s"
 
 
+def test_1k_nodes_deep_queue_stays_responsive(head):
+    """The head envelope at reference shape (release/benchmarks/
+    README.md: 2k nodes / 1M queued): 1,000 registered nodes must not
+    slow the dispatch path. Nodes carry capacity no {CPU: 1} task can
+    use, so every queued task scans past them — the per-scheduling-
+    class pending queues make that one probe per class per pass
+    (gcs._PendingQueue), not one per task."""
+    cluster = Cluster(initialize_head=False)
+    t0 = time.monotonic()
+    for i in range(1000):
+        cluster.add_node(resources={"CPU": 0.001}, label=f"v{i}")
+    reg_dt = time.monotonic() - t0
+    assert len(ray_tpu.nodes()) >= 1001
+    assert reg_dt < 30.0, f"1k registrations took {reg_dt:.1f}s"
+
+    @ray_tpu.remote(num_cpus=1)
+    def unit(i):
+        return i
+
+    n = 10_000
+    t0 = time.monotonic()
+    refs = [unit.remote(i) for i in range(n)]
+    out = ray_tpu.get(refs, timeout=600)
+    rate = n / (time.monotonic() - t0)
+    assert len(out) == n and out[-1] == n - 1
+    # Must stay in the same envelope as the 120-node drain (was ~6k/s
+    # before per-class queues O(queue x nodes) would collapse this).
+    assert rate > 300, f"drained at {rate:.0f}/s with 1k nodes registered"
+
+
 def test_pg_churn_across_many_nodes(head):
     """PG create/remove across a wide cluster: bundle reservation is a
     per-node 2PC against the resource ledger; churn must not leak."""
