@@ -168,6 +168,16 @@ static int enc_reply(Buf *b, PyObject *t) {
     req_id = PyLong_AsLong(o);
     if (req_id < 0 || req_id > UINT32_MAX) return -2;
     results = PyTuple_GET_ITEM(t, 3);
+    /* error replies carry results=None — encode as zero results (the
+     * receiver checks the error field first); rejecting None silently
+     * pushed every error reply onto the pickle fallback */
+    if (results == Py_None) {
+        if (buf_u8(b, K_REPLY) < 0 || buf_u32(b, (uint32_t)req_id) < 0)
+            return -1;
+        int r0;
+        if ((r0 = buf_obytes(b, PyTuple_GET_ITEM(t, 2))) != 0) return r0;
+        return buf_u16(b, 0) < 0 ? -1 : 0;
+    }
     if (!PyList_Check(results)) return -2;
     n = PyList_GET_SIZE(results);
     if (n > UINT16_MAX) return -2;
@@ -193,7 +203,12 @@ static int enc_reply(Buf *b, PyObject *t) {
             if (buf_u64(b, (uint64_t)sz) < 0) return -1;
         }
         children = PyTuple_GET_ITEM(res, 3);
-        if (PyTuple_Check(children)) {
+        if (children == Py_None) {
+            /* the common case: no child refs captured in the result —
+             * must NOT fall back to pickle (it did until round 5: every
+             * childless direct reply silently paid the pickle path) */
+            if (buf_u16(b, 0) < 0) return -1;
+        } else if (PyTuple_Check(children)) {
             nc = PyTuple_GET_SIZE(children);
             if (nc > UINT16_MAX) return -2;
             if (buf_u16(b, (uint16_t)nc) < 0) return -1;

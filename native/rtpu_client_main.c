@@ -1,0 +1,145 @@
+/* Test driver for the C ABI client: called by tests/test_capi.py with
+ * a live actor's direct socket, the session authkey, and the actor id;
+ * performs a scripted sequence of calls and prints parseable results.
+ *
+ * usage: rtpu_client_test <unix_path> <authkey_hex> <aid_hex>
+ */
+#include "rtpu_client.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+static int unhex(const char *s, uint8_t *out, size_t outlen) {
+    size_t n = strlen(s);
+    size_t i;
+    if (n != outlen * 2) return -1;
+    for (i = 0; i < outlen; i++) {
+        unsigned v;
+        if (sscanf(s + 2 * i, "%2x", &v) != 1) return -1;
+        out[i] = (uint8_t)v;
+    }
+    return 0;
+}
+
+static void print_value(const char *tag, const rtpu_value *v) {
+    switch (v->kind) {
+    case RTPU_VAL_NONE:
+        printf("%s none\n", tag);
+        break;
+    case RTPU_VAL_BOOL:
+        printf("%s bool %lld\n", tag, (long long)v->i);
+        break;
+    case RTPU_VAL_INT:
+        printf("%s int %lld\n", tag, (long long)v->i);
+        break;
+    case RTPU_VAL_FLOAT:
+        printf("%s float %.9g\n", tag, v->f);
+        break;
+    case RTPU_VAL_STR:
+        printf("%s str %.*s\n", tag, (int)v->len, (const char *)v->data);
+        break;
+    case RTPU_VAL_BYTES:
+        printf("%s bytes %zu\n", tag, v->len);
+        break;
+    default:
+        printf("%s opaque %zu\n", tag, v->len);
+    }
+}
+
+int main(int argc, char **argv) {
+    char err[256];
+    uint8_t authkey[32], aid[16];
+    rtpu_value result;
+
+    setvbuf(stdout, NULL, _IOLBF, 0); /* progress visible under a pipe */
+    if (argc != 4) {
+        fprintf(stderr, "usage: %s <path> <authkey_hex> <aid_hex>\n", argv[0]);
+        return 2;
+    }
+    size_t keylen = strlen(argv[2]) / 2;
+    if (keylen > sizeof authkey || unhex(argv[2], authkey, keylen) ||
+        unhex(argv[3], aid, 16)) {
+        fprintf(stderr, "bad hex args\n");
+        return 2;
+    }
+    rtpu_conn *c = rtpu_connect(argv[1], authkey, keylen, err, sizeof err);
+    if (!c) {
+        fprintf(stderr, "connect: %s\n", err);
+        return 1;
+    }
+    fprintf(stderr, "connected\n");
+
+    /* str result, no args */
+    if (rtpu_actor_call(c, aid, "ping", NULL, 0, &result, err, sizeof err)) {
+        fprintf(stderr, "ping: %s\n", err);
+        return 1;
+    }
+    print_value("ping", &result);
+
+    /* int result, int args */
+    rtpu_value add_args[2] = {
+        {.kind = RTPU_VAL_INT, .i = 40},
+        {.kind = RTPU_VAL_INT, .i = 2},
+    };
+    if (rtpu_actor_call(c, aid, "add", add_args, 2, &result, err, sizeof err)) {
+        fprintf(stderr, "add: %s\n", err);
+        return 1;
+    }
+    print_value("add", &result);
+
+    /* big int through LONG1 both ways */
+    rtpu_value big_args[1] = {
+        {.kind = RTPU_VAL_INT, .i = 1234567890123456789LL},
+    };
+    if (rtpu_actor_call(c, aid, "add1", big_args, 1, &result, err, sizeof err)) {
+        fprintf(stderr, "add1: %s\n", err);
+        return 1;
+    }
+    print_value("add1", &result);
+
+    /* float round trip */
+    rtpu_value f_args[1] = {{.kind = RTPU_VAL_FLOAT, .f = 1.5}};
+    if (rtpu_actor_call(c, aid, "fmul", f_args, 1, &result, err, sizeof err)) {
+        fprintf(stderr, "fmul: %s\n", err);
+        return 1;
+    }
+    print_value("fmul", &result);
+
+    /* bytes echo */
+    static const uint8_t blob[300] = {7};
+    rtpu_value b_args[1] = {
+        {.kind = RTPU_VAL_BYTES, .data = blob, .len = sizeof blob},
+    };
+    if (rtpu_actor_call(c, aid, "echo_len", b_args, 1, &result, err,
+                        sizeof err)) {
+        fprintf(stderr, "echo_len: %s\n", err);
+        return 1;
+    }
+    print_value("echo_len", &result);
+
+    /* str args */
+    rtpu_value s_args[1] = {
+        {.kind = RTPU_VAL_STR, .data = (const uint8_t *)"wörld", .len = 6},
+    };
+    if (rtpu_actor_call(c, aid, "greet", s_args, 1, &result, err, sizeof err)) {
+        fprintf(stderr, "greet: %s\n", err);
+        return 1;
+    }
+    print_value("greet", &result);
+
+    /* remote exception surfaces as RTPU_ERR_REMOTE */
+    int rc = rtpu_actor_call(c, aid, "boom", NULL, 0, &result, err, sizeof err);
+    printf("boom rc %d\n", rc);
+
+    /* connection survives the error */
+    if (rtpu_actor_call(c, aid, "ping", NULL, 0, &result, err, sizeof err)) {
+        fprintf(stderr, "ping2: %s\n", err);
+        return 1;
+    }
+    print_value("ping2", &result);
+
+    rtpu_close(c);
+    printf("ok\n");
+    return 0;
+}

@@ -1,0 +1,108 @@
+"""C ABI client (native/rtpu_client.c): a pure-C caller drives a live
+actor over the direct socket — frame codec, HMAC handshake, pickle
+writer/reader all independently implemented in C, so this also
+cross-validates the fastpath wire format end to end.
+
+Reference contrast: the reference's cpp/ worker API hosts actors and
+tasks in C++; ray_tpu's compute path is jax/Python by design, so the C
+surface targets the embed case (a C/C++ service calling a deployed
+actor). See native/rtpu_client.h.
+"""
+import os
+import subprocess
+import time
+
+import pytest
+
+import ray_tpu
+
+BUILD = os.path.join(
+    os.path.dirname(__file__), "..", "ray_tpu", "_private", "_native"
+)
+BIN = os.path.join(BUILD, "rtpu_client_test")
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def client_bin():
+    if not os.path.exists(BIN):
+        subprocess.run(
+            ["make", os.path.relpath(BIN, "native")],
+            cwd=os.path.join(os.path.dirname(__file__), "..", "native"),
+            check=True,
+            capture_output=True,
+        )
+    return BIN
+
+
+@ray_tpu.remote
+class Target:
+    def ping(self):
+        return "pong"
+
+    def add(self, a, b):
+        return a + b
+
+    def add1(self, a):
+        return a + 1
+
+    def fmul(self, x):
+        return x * 2.0
+
+    def echo_len(self, b):
+        assert isinstance(b, bytes)
+        return len(b)
+
+    def greet(self, name):
+        return f"hello {name}"
+
+    def boom(self):
+        raise ValueError("kaboom")
+
+
+def _direct_info(handle):
+    """(direct_addr, aid_hex, authkey_hex) for a live actor."""
+    from ray_tpu._private.worker import global_client
+
+    client = global_client()
+    aid = handle._actor_id.binary()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        reply = client.request({"type": "get_actor_direct", "actor_id": aid})
+        if reply.get("addr"):
+            return reply["addr"], aid.hex(), client._authkey.hex()
+        time.sleep(0.1)
+    raise TimeoutError("actor direct addr not available")
+
+
+def test_c_client_calls_live_actor(cluster, client_bin):
+    t = Target.remote()
+    assert ray_tpu.get(t.ping.remote()) == "pong"  # ensure ALIVE
+    addr, aid_hex, key_hex = _direct_info(t)
+
+    out = subprocess.run(
+        [client_bin, addr, key_hex, aid_hex],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert "ping str pong" in lines
+    assert "add int 42" in lines
+    assert "add1 int 1234567890123456790" in lines
+    assert "fmul float 3" in lines
+    assert "echo_len int 300" in lines
+    assert "greet str hello wörld" in lines
+    assert "boom rc -3" in lines  # RTPU_ERR_REMOTE, conn survives
+    assert "ping2 str pong" in lines
+    assert lines[-1] == "ok"
+
+    # The Python side still talks to the same actor afterwards.
+    assert ray_tpu.get(t.add.remote(1, 2)) == 3
