@@ -1,0 +1,296 @@
+"""GCE-shaped provider (autoscaler/gce.py) against recorded API
+fixtures: async operation polling, the real error taxonomy (quota 403,
+stockout-in-operation, 409 adopt, 404 idempotent delete, 429 backoff),
+and atomic TPU-slice rollback — plus the v2 reconciler's retry contract
+on provider failures (reference: gcp/node_provider.py behavior)."""
+import json
+import os
+
+import pytest
+
+from ray_tpu.autoscaler.gce import (
+    ALREADY_EXISTS,
+    GceApiError,
+    GceCompute,
+    GceNodeProvider,
+    NOT_FOUND,
+    QUOTA_EXCEEDED,
+    STOCKOUT,
+)
+from ray_tpu.autoscaler.v2 import (
+    ALLOCATION_FAILED,
+    Instance,
+    QUEUED,
+    Reconciler,
+    REQUESTED,
+    TERMINATED,
+)
+
+FIXTURES = json.load(
+    open(os.path.join(os.path.dirname(__file__), "fixtures/gce/responses.json"))
+)
+
+
+def fx(key: str, **subs) -> dict:
+    """Instantiate a recorded response with concrete names."""
+    blob = json.dumps(FIXTURES[key])
+    for k, v in subs.items():
+        blob = blob.replace("{%s}" % k, str(v))
+    return json.loads(blob)
+
+
+def _api_error(key: str, **subs) -> GceApiError:
+    body = fx(key, **subs)["error"]
+    return GceApiError(
+        body["code"], body["errors"][0]["reason"], body["message"]
+    )
+
+
+class FixtureGce(GceCompute):
+    """Replays recorded responses. Mutations create pending operations
+    that advance PENDING -> RUNNING -> DONE across get_operation polls
+    (GCE mutations are async); tests inject error fixtures per call."""
+
+    def __init__(self, cluster="c1", zone="us-central1-b"):
+        self.cluster = cluster
+        self.zone = zone
+        self.vms: dict = {}
+        self.tpus: dict = {}
+        self.ops: dict = {}
+        self.calls: list = []
+        self.inject: dict = {}  # method name -> GceApiError (once)
+
+    def _maybe_fail(self, method: str):
+        err = self.inject.pop(method, None)
+        if err is not None:
+            raise err
+
+    def _new_op(self, name: str, on_done, error_fixture=None) -> dict:
+        op = fx("operation_pending", opname=name, zone=self.zone, name=name)
+        self.ops[op["name"]] = {
+            "polls": 0, "on_done": on_done, "error_fixture": error_fixture,
+        }
+        return op
+
+    def insert_instance(self, zone, body):
+        self.calls.append(("insert_instance", body["name"]))
+        self._maybe_fail("insert_instance")
+        name = body["name"]
+        return self._new_op(
+            name, lambda: self.vms.__setitem__(name, body)
+        )
+
+    def delete_instance(self, zone, name):
+        self.calls.append(("delete_instance", name))
+        self._maybe_fail("delete_instance")
+        if name not in self.vms:
+            raise _api_error("error_not_found", zone=zone, name=name)
+        return self._new_op(f"del-{name}", lambda: self.vms.pop(name, None))
+
+    def list_instances(self, zone, label_filter):
+        out = []
+        for name, body in self.vms.items():
+            vm = fx(
+                "instance_running",
+                name=name,
+                zone=zone,
+                cluster=body["labels"]["ray-cluster-name"],
+                node_type=body["labels"]["ray-node-type"],
+            )
+            if all(vm["labels"].get(k) == v for k, v in label_filter.items()):
+                out.append(vm)
+        return out
+
+    def get_operation(self, zone, op_name):
+        st = self.ops[op_name]
+        st["polls"] += 1
+        if st["polls"] == 1:
+            out = fx("operation_running")
+        elif st["error_fixture"]:
+            out = fx(st["error_fixture"], zone=zone)
+        else:
+            st["on_done"]()
+            out = fx("operation_done")
+        out["name"] = op_name
+        return out
+
+    # ------------------------------------------------------------- TPU
+    def create_tpu_node(self, zone, node_id, body):
+        self.calls.append(("create_tpu_node", node_id))
+        self._maybe_fail("create_tpu_node")
+        err_fx = self.inject.pop("tpu_operation_error", None)
+        return self._new_op(
+            node_id,
+            lambda: self.tpus.__setitem__(node_id, body),
+            error_fixture=err_fx,
+        )
+
+    def delete_tpu_node(self, zone, node_id):
+        self.calls.append(("delete_tpu_node", node_id))
+        if node_id not in self.tpus and not self.inject.pop(
+            "tpu_delete_exists", None
+        ):
+            raise _api_error("error_not_found", zone=zone, name=node_id)
+        return self._new_op(
+            f"del-{node_id}", lambda: self.tpus.pop(node_id, None)
+        )
+
+    def list_tpu_nodes(self, zone, label_filter):
+        out = []
+        for name, body in self.tpus.items():
+            node = fx(
+                "tpu_node_ready",
+                name=name,
+                zone=zone,
+                cluster=body["labels"]["ray-cluster-name"],
+                node_type=body["labels"]["ray-node-type"],
+            )
+            if all(node["labels"].get(k) == v for k, v in label_filter.items()):
+                out.append(node)
+        return out
+
+    def get_tpu_operation(self, op_name):
+        return self.get_operation(self.zone, op_name)
+
+
+TEMPLATES = {
+    "cpu8": {"machine_type": "n2-standard-8"},
+    "v5e-16": {"accelerator_type": "v5litepod-16", "hosts": 2},
+}
+
+
+def _provider(api=None):
+    api = api or FixtureGce()
+    return api, GceNodeProvider(
+        api,
+        cluster_name=api.cluster,
+        zone=api.zone,
+        node_type_templates=TEMPLATES,
+    )
+
+
+def _inst(node_type="cpu8", iid="i-0001", hosts=1) -> Instance:
+    return Instance(
+        instance_id=iid, node_type=node_type, resources={"CPU": 8},
+        hosts=hosts,
+    )
+
+
+def test_launch_polls_operation_to_done_and_lists_running():
+    api, p = _provider()
+    cloud_id = p.launch(_inst())
+    assert cloud_id == "ray-c1-i-0001"
+    # The mutation was async: at least one RUNNING poll happened.
+    assert any(st["polls"] >= 2 for st in api.ops.values())
+    running = p.running_instances()
+    assert cloud_id in running
+    assert running[cloud_id]["node_type"] == "cpu8"
+
+
+def test_quota_error_is_typed_and_retryable():
+    api, p = _provider()
+    api.inject["insert_instance"] = _api_error(
+        "error_quota", zone=api.zone, name="x"
+    )
+    with pytest.raises(GceApiError) as ei:
+        p.launch(_inst())
+    assert ei.value.reason == QUOTA_EXCEEDED
+    assert ei.value.retryable
+
+
+def test_rate_limit_is_retryable_bad_request_is_not():
+    assert _api_error("error_rate_limited", zone="z", name="n").retryable
+    assert not GceApiError(400, "invalid", "bad template").retryable
+
+
+def test_already_exists_adopts_instance():
+    """A retried launch whose first insert succeeded (lost response)
+    adopts the live VM instead of failing — names are deterministic."""
+    api, p = _provider()
+    p.launch(_inst())
+    api.inject["insert_instance"] = _api_error(
+        "error_already_exists", zone=api.zone, name="ray-c1-i-0001"
+    )
+    assert p.launch(_inst()) == "ray-c1-i-0001"
+
+
+def test_terminate_is_idempotent_on_404():
+    api, p = _provider()
+    cid = p.launch(_inst())
+    p.terminate(cid)
+    assert cid not in api.vms
+    p.terminate(cid)  # second delete hits 404: swallowed
+
+
+def test_tpu_slice_stockout_rolls_back_whole_node():
+    """Stockouts surface on the DONE operation, not the create call;
+    the half-provisioned node must be deleted before the error
+    propagates (atomic slices never leak quota)."""
+    api, p = _provider()
+    api.inject["tpu_operation_error"] = "operation_done_stockout"
+    api.inject["tpu_delete_exists"] = True  # node exists half-made
+    with pytest.raises(GceApiError) as ei:
+        p.launch(_inst("v5e-16", iid="i-tpu1", hosts=2))
+    assert ei.value.reason == STOCKOUT
+    assert ei.value.retryable
+    assert ("delete_tpu_node", "ray-c1-i-tpu1") in api.calls
+    assert "ray-c1-i-tpu1" not in api.tpus
+
+
+def test_tpu_slice_launch_and_list():
+    api, p = _provider()
+    cid = p.launch(_inst("v5e-16", iid="i-tpu2", hosts=2))
+    running = p.running_instances()
+    assert running[cid] == {
+        "kind": "tpu", "node_type": "v5e-16", "hosts": 2,
+    }
+    p.terminate(cid)
+    assert cid not in api.tpus
+
+
+def test_listing_filters_foreign_clusters():
+    api, p = _provider()
+    p.launch(_inst())
+    # A VM belonging to another ray cluster in the same zone/project.
+    api.vms["ray-other-i-9"] = {
+        "name": "ray-other-i-9",
+        "labels": {"ray-cluster-name": "other", "ray-node-type": "cpu8"},
+    }
+    assert set(p.running_instances()) == {"ray-c1-i-0001"}
+
+
+def test_reconciler_retries_provider_failure_with_budget():
+    """The v2 reconciler's contract on a raising provider: REQUESTED ->
+    ALLOCATION_FAILED, re-QUEUED up to max_launch_attempts, then
+    TERMINATED (reference: instance_manager retry budget)."""
+    api, p = _provider()
+    r = Reconciler(
+        {"cpu8": {"resources": {"CPU": 8}}}, p, max_launch_attempts=2
+    )
+    inst = r.im.create("cpu8", {"CPU": 8})
+    api.inject["insert_instance"] = _api_error(
+        "error_quota", zone=api.zone, name="x"
+    )
+    r._launch(inst)
+    assert inst.status == ALLOCATION_FAILED
+    r._sync_cloud({}, now=0.0)
+    assert inst.status == QUEUED
+    api.inject["insert_instance"] = _api_error(
+        "error_quota", zone=api.zone, name="x"
+    )
+    r._launch(inst)
+    assert inst.status == ALLOCATION_FAILED
+    r._sync_cloud({}, now=0.0)
+    assert inst.status == TERMINATED  # budget exhausted
+
+    # And a clean retry path: fresh instance launches on attempt 2.
+    inst2 = r.im.create("cpu8", {"CPU": 8})
+    api.inject["insert_instance"] = _api_error(
+        "error_rate_limited", zone=api.zone, name="x"
+    )
+    r._launch(inst2)
+    r._sync_cloud({}, now=0.0)
+    assert inst2.status == QUEUED
+    r._launch(inst2)
+    assert inst2.status == REQUESTED
+    assert inst2.cloud_instance_id in p.running_instances()
