@@ -182,7 +182,14 @@ def _driver_push(msg):
     if msg.get("type") == "log_lines":
         import sys as _sys
 
+        from ..experimental import tqdm_ray
+
         for node, worker_tag, line in msg["entries"]:
+            # Progress-bar control lines multiplex onto the driver's
+            # bar renderer instead of echoing (experimental/tqdm_ray).
+            if line.startswith(tqdm_ray.MAGIC):
+                if tqdm_ray.handle_magic_line(line):
+                    continue
             print(
                 f"({node} worker={worker_tag}) {line}",
                 file=_sys.stdout, flush=True,

@@ -1,0 +1,1 @@
+"""Experimental utilities (reference: python/ray/experimental/)."""

@@ -154,6 +154,32 @@ def _print_table(items, columns):
         print("  ".join(str(i.get(c, "")).ljust(widths[c]) for c in columns))
 
 
+def cmd_debug(args):
+    _connect()
+    from ray_tpu.util import rpdb
+
+    live = rpdb.sessions()
+    if not live:
+        print("no rpdb sessions waiting")
+        return 1
+    if args.session is None:
+        if len(live) > 1:
+            print("multiple sessions; pick one:")
+            for name, addr in live:
+                print(f"  {name}  {addr}")
+            return 1
+        name, addr = live[0]
+    else:
+        match = dict(live).get(args.session)
+        if match is None:
+            print(f"no session {args.session!r}; waiting: {live}")
+            return 1
+        name, addr = args.session, match
+    print(f"attaching to {name} at {addr} (Ctrl-C to detach)")
+    rpdb.bridge(addr)
+    return 0
+
+
 def cmd_list(args):
     _connect()
     from ray_tpu.util import state as state_api
@@ -324,6 +350,15 @@ def main(argv=None):
                                      "objects", "placement_groups"])
     sp.add_argument("--limit", type=int, default=100)
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser(
+        "debug", help="attach to a waiting rpdb session (util/rpdb)"
+    )
+    sp.add_argument(
+        "session", nargs="?", default=None,
+        help="session name from the list (default: the only one)",
+    )
+    sp.set_defaults(fn=cmd_debug)
 
     sp = sub.add_parser("summary", help="summarize tasks")
     sp.add_argument("kind", choices=["tasks"])

@@ -1,0 +1,65 @@
+"""Remote debugger (util/rpdb.py; reference: python/ray/util/rpdb.py):
+set_trace() in a task parks it on a socket; a client attaches, inspects
+live frame state, and `c` resumes the task."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import rpdb
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_set_trace_attach_inspect_continue(cluster):
+    @ray_tpu.remote
+    def buggy(x):
+        secret = x * 7
+        rpdb.set_trace()
+        return secret
+
+    ref = buggy.remote(6)
+
+    # The session shows up in the registry while the task is parked.
+    deadline = time.time() + 60
+    live = []
+    while time.time() < deadline:
+        live = rpdb.sessions()
+        if live:
+            break
+        time.sleep(0.2)
+    assert live, "no rpdb session registered"
+    _, addr = live[0]
+
+    sock = rpdb.connect(addr)
+    f = sock.makefile("rw", buffering=1)
+
+    def read_until_prompt():
+        out = []
+        while True:
+            ch = f.read(1)
+            if not ch:
+                break
+            out.append(ch)
+            s = "".join(out)
+            if s.endswith("(rpdb) "):
+                return s
+        return "".join(out)
+
+    banner = read_until_prompt()
+    assert "buggy" in banner or "rpdb" in banner or "->" in banner
+    f.write("p secret\n")
+    out = read_until_prompt()
+    assert "42" in out
+    f.write("c\n")
+    f.flush()
+    sock.close()
+
+    assert ray_tpu.get(ref, timeout=60) == 42
+    # Session deregistered once attached.
+    assert not rpdb.sessions()
