@@ -33,6 +33,10 @@ _DEFAULTS: Dict[str, Any] = {
     # co-hosting. Actors with default resources (num_cpus=0) keep a
     # dedicated process (reference process-per-actor isolation).
     "max_actors_per_worker": 64,
+    # Prefer opening another shared host (up to ~node CPU count) once
+    # every existing host carries this many actors: dense packing saves
+    # interpreter boots, spreading saves call-path parallelism.
+    "actor_host_spread_threshold": 8,
     "worker_register_timeout_s": 30.0,
     "worker_idle_timeout_s": 300.0,
     # Health checking (reference: gcs_health_check_manager.h).

@@ -292,6 +292,12 @@ class GcsServer:
         self._orphan_actor_tasks: Dict[bytes, List[TaskSpec]] = {}
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.nodes: Dict[bytes, NodeState] = {}
+        # Dead nodes purge from the live table (tombstones would bloat
+        # every persistence cut and scheduler/listing scan — 1k churned
+        # nodes made registrations 10x slower); a bounded history ring
+        # keeps them visible to the state API (reference:
+        # maximum_gcs_dead_node_cached_count, gcs_node_manager.cc).
+        self.dead_nodes: deque = deque(maxlen=1000)
         self.placement_groups: Dict[bytes, PlacementGroupState] = {}
         self._pending = _PendingQueue()
         # Per-task state transitions for the state API, `ray_tpu
@@ -1722,7 +1728,7 @@ class GcsServer:
                         "available": dict(n.available),
                     }
                     for n in self.nodes.values()
-                ]
+                ] + list(self.dead_nodes)
             elif kind == "workers":
                 items = [
                     {
@@ -2637,6 +2643,7 @@ class GcsServer:
             "NODE_INFO", nid.hex(), {"state": "DEAD", "reason": reason}
         )
         with self._lock:
+            self._purge_dead_node(nid, reason)
             self._work.notify_all()
 
     def _h_add_node(self, state, msg):
@@ -2736,7 +2743,29 @@ class GcsServer:
             self._handle_worker_death(
                 w.worker_id.binary(), "node removed", respawn=False
             )
+        with self._lock:
+            self._purge_dead_node(msg["node_id"], "node removed")
         state["peer"].reply(msg, ok=True)
+
+    def _purge_dead_node(self, nid: bytes, reason: str) -> None:
+        """Drop a dead node from the live table into the bounded history
+        ring. Caller holds the lock."""
+        node = self.nodes.pop(nid, None)
+        if node is None:
+            return
+        self.dead_nodes.append(
+            {
+                "node_id": node.node_id.hex(),
+                "alive": False,
+                "label": node.label,
+                "total": dict(node.total),
+                "available": {},
+                "death_reason": reason,
+                "died_at": time.time(),
+            }
+        )
+        self._version += 1
+        self._table_versions["nodes"] += 1
 
     # ------------------------------------------------------------- scheduling
 
@@ -3130,34 +3159,47 @@ class GcsServer:
     def _pick_worker(self, node: NodeState, spec: TaskSpec) -> Optional[WorkerHandle]:
         needs_tpu = spec.resources.get("TPU", 0) > 0
         if not needs_tpu and self._packable(spec):
-            # Existing shared host with a free slot first; else convert
-            # an idle worker into a host (it leaves the fungible pool).
+            # Pick the least-loaded live host; but while every host is
+            # at/over the spread threshold and the node can still open
+            # hosts, prefer converting another idle worker — packing
+            # density saves boots, spread saves the call path (100
+            # actors on 2 processes serialize their storms on 2 GILs).
             cap = RayConfig.max_actors_per_worker
+            best, best_load = None, None
             for wid in list(node.actor_hosts):
                 w = self.workers.get(wid)
                 if w is None or w.state == W_DEAD or not w.actor_host:
                     node.actor_hosts.discard(wid)
                     continue
-                if (
-                    w.conn is not None
-                    and len(w.packed) + sum(
-                        1 for s in w.inflight.values() if s.actor_creation
-                    ) < cap
-                ):
-                    return w
-            for wid in list(node.pool):
-                w = self.workers.get(wid)
-                if (
-                    w is not None
-                    and w.state == W_IDLE
-                    and w.conn is not None
-                    and not w.tpu
-                ):
-                    node.pool.discard(wid)
-                    w.actor_host = True
-                    node.actor_hosts.add(wid)
-                    return w
-            return None
+                if w.conn is None:
+                    continue
+                load = len(w.packed) + sum(
+                    1 for s in w.inflight.values() if s.actor_creation
+                )
+                if load < cap and (best_load is None or load < best_load):
+                    best, best_load = w, load
+            host_cap = max(4, int(node.total.get("CPU", 1)))
+            want_new = (
+                best is None
+                or (
+                    best_load >= RayConfig.actor_host_spread_threshold
+                    and len(node.actor_hosts) < host_cap
+                )
+            )
+            if want_new:
+                for wid in list(node.pool):
+                    w = self.workers.get(wid)
+                    if (
+                        w is not None
+                        and w.state == W_IDLE
+                        and w.conn is not None
+                        and not w.tpu
+                    ):
+                        node.pool.discard(wid)
+                        w.actor_host = True
+                        node.actor_hosts.add(wid)
+                        return w
+            return best
         for wid in list(node.pool):
             w = self.workers.get(wid)
             if (
