@@ -1,0 +1,30 @@
+# CI entry points (reference: the Bazel/Buildkite pipelines in
+# .buildkite/ + ci/ — here one deterministic make surface: native
+# build, bytecode lint, stress binaries, full suite).
+
+.PHONY: ci native lint test stress clean
+
+ci: native lint test
+
+native:
+	$(MAKE) -C native
+
+# No flake8/pyflakes in this image: compileall catches syntax errors in
+# every module (including ones the suite never imports) and -W error
+# on import smoke-checks the public surface.
+lint:
+	python -m compileall -q ray_tpu tests
+	JAX_PLATFORMS=cpu python -c "import ray_tpu, ray_tpu.data, \
+	ray_tpu.train, ray_tpu.tune, ray_tpu.serve, ray_tpu.rllib, \
+	ray_tpu.workflow, ray_tpu.dag, ray_tpu.autoscaler.gce, \
+	ray_tpu.util.multiprocessing, ray_tpu.experimental.tqdm_ray"
+
+test:
+	python -m pytest tests/ -q
+
+stress:
+	$(MAKE) -C native stress-asan
+	./ray_tpu/_private/_native/store_stress_asan 30
+
+clean:
+	$(MAKE) -C native clean

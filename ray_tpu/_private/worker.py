@@ -212,6 +212,13 @@ def _atexit_shutdown():
 
 def shutdown():
     try:
+        from . import usage_stats
+
+        if _global.mode == DRIVER_MODE:
+            usage_stats.flush()  # local-only sink (zero egress)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
         from ..util import pubsub as _pubsub
 
         _pubsub._reset_for_shutdown()

@@ -61,3 +61,7 @@ __all__ = [
     "read_webdataset",
     "read_tfrecords",
 ]
+
+from ray_tpu._private import usage_stats as _usage
+
+_usage.record_library_usage("data")

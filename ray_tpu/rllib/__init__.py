@@ -35,3 +35,7 @@ __all__ = [
     "SingleAgentEpisode",
     "make_multi_agent",
 ]
+
+from ray_tpu._private import usage_stats as _usage
+
+_usage.record_library_usage("rllib")

@@ -154,6 +154,23 @@ def _print_table(items, columns):
         print("  ".join(str(i.get(c, "")).ljust(widths[c]) for c in columns))
 
 
+def cmd_usage(args):
+    import json as _json
+
+    from ray_tpu._private import usage_stats
+
+    if not usage_stats.enabled():
+        print("usage stats disabled (RAY_TPU_USAGE_STATS_ENABLED=0)")
+        return 0
+    rows = usage_stats.read_all()
+    if not rows:
+        print("no usage records (sink: local JSONL, zero egress)")
+        return 0
+    for r in rows[-20:]:
+        print(_json.dumps(r))
+    return 0
+
+
 def cmd_debug(args):
     _connect()
     from ray_tpu.util import rpdb
@@ -350,6 +367,11 @@ def main(argv=None):
                                      "objects", "placement_groups"])
     sp.add_argument("--limit", type=int, default=100)
     sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser(
+        "usage", help="show locally-recorded usage stats (never uploaded)"
+    )
+    sp.set_defaults(fn=cmd_usage)
 
     sp = sub.add_parser(
         "debug", help="attach to a waiting rpdb session (util/rpdb)"

@@ -63,3 +63,7 @@ __all__ = [
     "start",
     "status",
 ]
+
+from ray_tpu._private import usage_stats as _usage
+
+_usage.record_library_usage("serve")

@@ -13,3 +13,7 @@ from .config import (  # noqa: F401
 )
 from .session import get_context, report  # noqa: F401
 from .trainer import JaxTrainer, get_checkpoint  # noqa: F401
+
+from ray_tpu._private import usage_stats as _usage
+
+_usage.record_library_usage("train")

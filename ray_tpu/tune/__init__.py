@@ -101,3 +101,7 @@ __all__ = [
     "uniform",
     "with_parameters",
 ]
+
+from ray_tpu._private import usage_stats as _usage
+
+_usage.record_library_usage("tune")

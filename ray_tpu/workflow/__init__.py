@@ -34,3 +34,7 @@ __all__ = [
     "run",
     "run_async",
 ]
+
+from ray_tpu._private import usage_stats as _usage
+
+_usage.record_library_usage("workflow")

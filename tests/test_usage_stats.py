@@ -1,0 +1,47 @@
+"""Offline usage stats (reference: _private/usage/usage_lib.py —
+same recording surface, local-JSONL sink, nothing leaves the host)."""
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import usage_stats
+
+
+@pytest.fixture
+def sink(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TEMP_DIR", str(tmp_path))
+    monkeypatch.setattr(usage_stats, "_path", None)
+    yield tmp_path
+
+
+def test_record_and_flush(sink):
+    usage_stats.record_library_usage("data")
+    usage_stats.record_extra_usage_tag("train_backend", "jax")
+    path = usage_stats.flush()
+    assert path and os.path.exists(path)
+    rows = usage_stats.read_all()
+    assert rows and "data" in rows[-1]["libraries"]
+    assert rows[-1]["tags"]["train_backend"] == "jax"
+
+
+def test_disable_env(sink, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    assert usage_stats.flush() is None
+
+
+def test_library_imports_register(sink):
+    import ray_tpu.data  # noqa: F401
+    import ray_tpu.tune  # noqa: F401
+
+    snap = usage_stats.cluster_snapshot()
+    assert "data" in snap["libraries"] and "tune" in snap["libraries"]
+
+
+def test_shutdown_flushes(sink):
+    ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    usage_stats.record_library_usage("core")
+    ray_tpu.shutdown()
+    rows = usage_stats.read_all()
+    assert rows and "core" in rows[-1]["libraries"]
