@@ -50,12 +50,19 @@ def _emit(extra_error: str | None = None) -> int:
             if extra_error is not None:
                 _RESULT["error"] = extra_error
             print(json.dumps(_RESULT), flush=True)
+            # Tell the external watchdog the line is out (it must not
+            # print a second one if we're merely slow to exit).
+            try:
+                open(_DONE_PATH, "w").close()
+            except OSError:
+                pass
     return 0
 
 
 def _update_result(**kw) -> None:
     with _EMIT_LOCK:
         _RESULT.update(**kw)
+    _dump_partial()
 
 
 def _update_extra(extra: dict, **kw) -> None:
@@ -63,9 +70,47 @@ def _update_extra(extra: dict, **kw) -> None:
     watchdog's json.dumps may walk it concurrently — same lock."""
     with _EMIT_LOCK:
         extra.update(**kw)
+    _dump_partial()
+
+
+_PARTIAL_PATH = f"/tmp/bench_partial_{os.getpid()}.json"
+_DONE_PATH = _PARTIAL_PATH + ".done"
+
+_WATCHDOG_SRC = r"""
+import json, os, signal, sys, time
+
+pid, partial, done, deadline = (
+    int(sys.argv[1]), sys.argv[2], sys.argv[3], float(sys.argv[4]),
+)
+end = time.time() + deadline
+while time.time() < end:
+    time.sleep(2)
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        sys.exit(0)  # parent exited (it printed or crashed visibly)
+if os.path.exists(done):
+    sys.exit(0)  # parent already emitted; it's just slow to die
+try:
+    with open(partial) as f:
+        res = json.load(f)
+except Exception:
+    res = {
+        "metric": "bench unavailable", "value": 0.0,
+        "unit": "tokens/s/chip", "vs_baseline": 0.0,
+    }
+res["error"] = f"bench_killed_by_external_watchdog_{int(deadline)}s"
+print(json.dumps(res), flush=True)
+try:
+    os.kill(pid, signal.SIGKILL)
+except ProcessLookupError:
+    pass
+"""
 
 
 def _start_watchdog(deadline_s: float) -> None:
+    # Layer 1: in-process timer — catches hangs where Python threads
+    # still run (device fetches that release the GIL).
     def fire():
         _emit(f"bench_deadline_exceeded_{int(deadline_s)}s")
         os._exit(0)
@@ -73,6 +118,35 @@ def _start_watchdog(deadline_s: float) -> None:
     t = threading.Timer(deadline_s, fire)
     t.daemon = True
     t.start()
+    # Layer 2: an EXTERNAL watchdog process — a wedged relay can block
+    # inside a C call HOLDING the GIL (observed: a second bench run sat
+    # 40 min past the timer with the timer thread starved), and no
+    # in-process mechanism runs then. The child inherits stdout, so the
+    # one JSON line still reaches the driver, read from the partial
+    # file the main thread keeps current.
+    _dump_partial()
+    try:
+        subprocess.Popen(
+            [
+                sys.executable, "-c", _WATCHDOG_SRC,
+                str(os.getpid()), _PARTIAL_PATH, _DONE_PATH,
+                # Fire AFTER layer 1 had its chance.
+                str(deadline_s + 30.0),
+            ],
+        )
+    except OSError:
+        pass
+
+
+def _dump_partial() -> None:
+    """Keep the external watchdog's view of _RESULT current."""
+    try:
+        blob = json.dumps(_RESULT)
+        with open(_PARTIAL_PATH + ".tmp", "w") as f:
+            f.write(blob)
+        os.replace(_PARTIAL_PATH + ".tmp", _PARTIAL_PATH)
+    except OSError:
+        pass
 
 
 def _probe_backend(timeout_s: float) -> str | None:

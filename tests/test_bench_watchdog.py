@@ -1,0 +1,85 @@
+"""bench.py's external watchdog: a wedged TPU relay can block the main
+process inside a C call HOLDING the GIL, starving every in-process
+timer — only a separate watchdog process can still get the one JSON
+line onto stdout for the driver (observed in round 5: a bench run sat
+40 minutes past its in-process deadline)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _load_watchdog_src():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "benchmod",
+        os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    # Executing bench.py top-level is safe: it only defines things.
+    spec.loader.exec_module(mod)
+    return mod._WATCHDOG_SRC
+
+
+def test_external_watchdog_emits_partial_and_kills(tmp_path):
+    src = _load_watchdog_src()
+    partial = tmp_path / "partial.json"
+    done = tmp_path / "done"
+    partial.write_text(json.dumps(
+        {"metric": "llama", "value": 123.0, "unit": "tok/s",
+         "vs_baseline": 1.5}
+    ))
+    # A "main" process wedged forever (stand-in for a GIL-held C call).
+    victim = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(600)"])
+    out = subprocess.run(
+        [sys.executable, "-c", src, str(victim.pid), str(partial),
+         str(done), "3"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    line = json.loads(out.stdout.strip())
+    assert line["value"] == 123.0
+    assert "external_watchdog" in line["error"]
+    # The wedged process was killed.
+    assert victim.wait(timeout=30) == -signal.SIGKILL
+
+
+def test_external_watchdog_silent_when_parent_exits(tmp_path):
+    src = _load_watchdog_src()
+    partial = tmp_path / "partial.json"
+    partial.write_text("{}")
+    victim = subprocess.Popen([sys.executable, "-c", "pass"])
+    victim.wait(timeout=30)  # reaped: the pid is truly gone (in real
+    # use the driver shell reaps bench.py promptly)
+    out = subprocess.run(
+        [sys.executable, "-c", src, str(victim.pid), str(partial),
+         str(tmp_path / 'done'), "30"],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert out.stdout.strip() == ""  # clean exit: no duplicate line
+
+
+def test_external_watchdog_respects_done_marker(tmp_path):
+    src = _load_watchdog_src()
+    partial = tmp_path / "partial.json"
+    partial.write_text("{}")
+    done = tmp_path / "done"
+    done.write_text("")  # main already printed its line
+    victim = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(600)"])
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", src, str(victim.pid), str(partial),
+             str(done), "3"],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.stdout.strip() == ""
+    finally:
+        victim.kill()
