@@ -76,6 +76,36 @@ def test_1k_nodes_deep_queue_stays_responsive(head):
     assert rate > 300, f"drained at {rate:.0f}/s with 1k nodes registered"
 
 
+def test_class_queues_stay_fair_under_saturation(head):
+    """Two resource classes contending for the same saturated CPUs:
+    the per-class pending queues rotate (gcs._schedule_once
+    move_to_end), so neither class starves while the other streams
+    (the old global FIFO's arrival-order property, class-granular)."""
+    @ray_tpu.remote(num_cpus=1)
+    def big(i):
+        time.sleep(0.01)
+        return ("big", i)
+
+    @ray_tpu.remote(num_cpus=0.5)
+    def small(i):
+        time.sleep(0.01)
+        return ("small", i)
+
+    # Saturate 2 CPUs with 80 queued tasks across two classes and watch
+    # completion order: the first finishers must include BOTH classes
+    # (a starved class would finish strictly after the other drained).
+    done_kinds = []
+    pending = [big.remote(i) for i in range(40)] + [
+        small.remote(i) for i in range(40)
+    ]
+    while pending and len(done_kinds) < 40:
+        ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=120)
+        done_kinds.extend(kind for kind, _ in ray_tpu.get(ready))
+    assert {"big", "small"} <= set(done_kinds), (
+        f"one class starved: first finishers {done_kinds[:10]}"
+    )
+
+
 def test_pg_churn_across_many_nodes(head):
     """PG create/remove across a wide cluster: bundle reservation is a
     per-node 2PC against the resource ledger; churn must not leak."""
