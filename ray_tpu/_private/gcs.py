@@ -2764,8 +2764,9 @@ class GcsServer:
                 "died_at": time.time(),
             }
         )
-        self._version += 1
-        self._table_versions["nodes"] += 1
+        # No durable-version bump: node bindings are deliberately not
+        # persisted (daemons re-register on reconnect) — "nodes" is not
+        # a _TABLES member.
 
     # ------------------------------------------------------------- scheduling
 
