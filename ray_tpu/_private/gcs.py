@@ -292,6 +292,9 @@ class GcsServer:
         self._orphan_actor_tasks: Dict[bytes, List[TaskSpec]] = {}
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.nodes: Dict[bytes, NodeState] = {}
+        # Removes that raced ahead of the entry's creation (see
+        # _h_update_refs): oid -> None, FIFO-bounded.
+        self._early_drops: "OrderedDict[bytes, None]" = OrderedDict()
         # Dead nodes purge from the live table (tombstones would bloat
         # every persistence cut and scheduler/listing scan — 1k churned
         # nodes made registrations 10x slower); a bounded history ring
@@ -897,6 +900,12 @@ class GcsServer:
             return
         for r in results:
             entry = self.objects.setdefault(r["object_id"], ObjectEntry())
+            if r["object_id"] in self._early_drops:
+                # The owner already dropped its ref before this (batched)
+                # completion created the entry: the _maybe_free below
+                # reclaims the result immediately.
+                del self._early_drops[r["object_id"]]
+                entry.had_holder = True
             if error_blob is not None:
                 entry.status = FAILED
                 entry.error = error_blob
@@ -1157,6 +1166,18 @@ class GcsServer:
             for oid in msg.get("remove", []):
                 entry = self.objects.get(oid)
                 if entry is None:
+                    # Leased-path race: the owner advertises return refs
+                    # client-side only, so the directory entry is born
+                    # from the worker's BATCHED task_done — under load
+                    # that batch can land after the owner's 100ms
+                    # ref-flush already dropped the ref. Remember the
+                    # drop so the seal frees immediately instead of
+                    # leaking a result nobody holds (bounded: stale
+                    # entries age out; removes for already-freed
+                    # objects simply expire here).
+                    self._early_drops[oid] = None
+                    while len(self._early_drops) > 8192:
+                        self._early_drops.popitem(last=False)
                     continue
                 # A removal implies the client held the ref, even if its
                 # add was compressed away within one flush window.

@@ -220,3 +220,50 @@ def test_fast_dropped_result_is_reclaimed(ray_start):
             break
         time.sleep(0.2)
     assert not live, f"{len(live)} fast-dropped results leaked"
+
+
+def test_drop_racing_delayed_task_done_is_reclaimed():
+    """The owner's ref-drop can reach the directory BEFORE the worker's
+    batched task_done creates the entry (leased path: return refs are
+    advertised client-side only, and under load the 4ms done-batch can
+    land after the 100ms ref flush). The early-drop ledger
+    (gcs._early_drops) must reclaim the result at seal time — observed
+    leaking 1-in-5 under a loaded full-suite run before the fix."""
+    import time
+
+    from ray_tpu._private.worker import _global
+
+    ray_tpu.init(
+        num_cpus=2,
+        # Delay every done-batch 150ms at the GCS: the driver's ref
+        # flush (100ms) now reliably wins the race the wild run hit
+        # intermittently.
+        _system_config={
+            "testing_rpc_delay_us": "task_done_batch=150000:150000"
+        },
+    )
+    try:
+        @ray_tpu.remote
+        def quick():
+            return list(range(500))
+
+        # Warm a leased worker so subsequent calls ride the direct path.
+        ray_tpu.get(quick.remote())
+        oids = []
+        for _ in range(5):
+            ref = quick.remote()
+            assert len(ray_tpu.get(ref)) == 500
+            oids.append(ref.id().binary())
+            del ref
+        gcs = _global.node.gcs
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            live = [o for o in oids if gcs.objects.get(o) is not None]
+            if not live:
+                break
+            time.sleep(0.2)
+        assert not live, (
+            f"{len(live)} results leaked past the early-drop ledger"
+        )
+    finally:
+        ray_tpu.shutdown()
