@@ -2,9 +2,9 @@
 # .buildkite/ + ci/ — here one deterministic make surface: native
 # build, bytecode lint, stress binaries, full suite).
 
-.PHONY: ci native lint test stress clean
+.PHONY: ci native lint test obs-smoke stress clean
 
-ci: native lint test
+ci: native lint test obs-smoke
 
 native:
 	$(MAKE) -C native
@@ -21,6 +21,14 @@ lint:
 
 test:
 	python -m pytest tests/ -q
+
+# Observability surface: flight-recorder event pipeline + tracing +
+# dashboard tests, including the recorder overhead-budget perf check
+# (test_flight_recorder_overhead_budget asserts ≤5% on the
+# single_client_tasks_async shape vs recording disabled).
+obs-smoke:
+	python -m pytest tests/test_observability.py \
+		tests/test_dashboard_tracing.py tests/test_logging.py -q
 
 stress:
 	$(MAKE) -C native stress-asan

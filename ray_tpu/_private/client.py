@@ -7,12 +7,14 @@ GCS over the session's unix socket.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from . import serialization
+from . import events as _events
 from . import fastpath as _fastpath
 from .config import RayConfig
 from .ids import ObjectID, WorkerID, fast_unique_bytes
@@ -310,6 +312,12 @@ class CoreClient:
 
     def submit(self, spec: TaskSpec) -> List[ObjectRef]:
         self._record_lineage(spec)
+        _rec = _events.get_recorder()
+        if _rec.enabled:
+            _rec.record(
+                _events.TASK, spec.task_id.hex(), "SUBMITTED",
+                {"route": "gcs", "name": spec.name},
+            )
         self.conn.send({"type": "submit_task", "spec": spec})
         owner = self.worker_id.binary()
         refs = [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
@@ -362,6 +370,11 @@ class CoreClient:
         """Push a task to a leased worker; None -> route via the GCS."""
         if not self._lease_eligible(spec):
             return None
+        # Flight recorder, compact form: ONE ring append per task
+        # carrying the submit/queue/lease boundaries in its attrs (the
+        # head expands it off the hot path — events._expand).
+        _rec = _events.get_recorder()
+        t_submit = time.time() if _rec.enabled else 0.0
         key = spec.scheduling_class()
         now = time.monotonic()
         with self._lease_lock:
@@ -395,6 +408,26 @@ class CoreClient:
             # strand the GCS-routed overflow behind held leases.
             with self._lease_lock:
                 lease["outstanding"] += 1
+        # t_submit truthy too: recording toggled on mid-submit must not
+        # ship a half-captured span (a 0.0 boundary poisons the phase
+        # histograms with epoch-sized durations).
+        if _rec.enabled and t_submit:
+            # Recorded BEFORE the push so the span is in the ring before
+            # the task can possibly execute — the head aggregator drains
+            # this process's ring ahead of shipped worker batches, which
+            # keeps submit→…→seal ordered without cross-process sync.
+            # t_queue = t_submit: a directly-pushed task never queued, so
+            # the queue phase is zero-width and the submit→lease-claim
+            # gap is attributed to the lease phase.
+            _rec.record(
+                _events.TASK, spec.task_id.hex(), "SUBMIT_SPAN",
+                {
+                    "t_submit": t_submit,
+                    "t_queue": t_submit,
+                    "t_lease": time.time(),
+                    "route": "lease",
+                },
+            )
         return self._push_leased(lease, spec)
 
     def _raylet_conn(self) -> Optional[PeerConn]:
@@ -651,6 +684,8 @@ class CoreClient:
         if conn is None or conn == "resolving" or isinstance(conn, str):
             return None
         tid = fast_unique_bytes()
+        if _events.enabled():
+            _events.record(_events.TASK, tid.hex(), "SUBMITTED", None)
         return self._send_frame(
             conn, aid, tid, method_name, args_blob, num_returns, deps,
             concurrency_group,
@@ -715,6 +750,10 @@ class CoreClient:
         is known so a single ordered stream flows down exactly one path —
         mixing paths could reorder a caller's calls."""
         aid = spec.actor_id.binary()
+        if _events.enabled():
+            _events.record(
+                _events.TASK, spec.task_id.hex(), "SUBMITTED", None
+            )
         with self._direct_lock:
             st = self._direct_conns.get(aid, _MISSING)
             if st is None:
@@ -956,7 +995,9 @@ class CoreClient:
             rfut, idx = entry
             try:
                 reply = rfut.result(timeout=remaining)
-            except TimeoutError:
+            except (TimeoutError, concurrent.futures.TimeoutError):
+                # Both: only Python 3.11 unified futures.TimeoutError
+                # with the builtin.
                 raise GetTimeoutError(f"get timed out on {ref}") from None
             except BaseException:
                 # Connection lost: the failure callback rewrites the
@@ -1029,7 +1070,9 @@ class CoreClient:
             else:
                 try:
                     fields = ent.result(timeout=remaining)
-                except TimeoutError:
+                except (TimeoutError, concurrent.futures.TimeoutError):
+                    # Both: only Python 3.11 unified futures.TimeoutError
+                    # with the builtin.
                     raise GetTimeoutError(f"get timed out on {ref}") from None
             if direct and (
                 fields.get("via_gcs")
@@ -1223,12 +1266,35 @@ class CoreClient:
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Dict[str, Any]:
         return self.conn.request(msg, timeout=timeout)
 
+    def flush_runtime_events(self) -> None:
+        """Ship this process's flight-recorder ring to the head.
+
+        Workers normally piggyback on the done-batcher flush and the
+        head/driver shares a process with the aggregator; this covers
+        the remaining case (remote drivers) and is harmless elsewhere
+        (drain is destructive, so nothing double-ships)."""
+        rec = _events.get_recorder()
+        if not len(rec) and not rec.dropped:
+            return
+        msg = {"type": "event_batch", "source": rec.source}
+        items, dropped = rec.attach(msg)
+        try:
+            self.conn.send(msg)
+        except ConnectionLost:
+            rec.count_lost(items, dropped)
+
     def state_read(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         """A request that reads task/object state: flushes this
         process's own coalesced completion records first so the answer
         includes everything this process has already observed finish."""
         if self.pre_state_read_flush is not None:
             self.pre_state_read_flush()
+        if self.role != "worker":
+            # Workers flush via their done batcher (pre_state_read_flush
+            # piggybacks the ring); non-worker clients ship here so a
+            # remote driver's submission events reach the aggregator
+            # before its own read is answered.
+            self.flush_runtime_events()
         return self.request(msg)
 
     def send(self, msg: Dict[str, Any]) -> None:

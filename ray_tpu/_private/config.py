@@ -74,6 +74,16 @@ _DEFAULTS: Dict[str, Any] = {
     "testing_memory_usage_file": "",
     # Metrics.
     "metrics_report_interval_ms": 1000,
+    # Flight recorder (reference: task_event_buffer.h +
+    # gcs_task_manager.h): always-on structured runtime events.
+    # Recording is a single ring append per event; disable only to
+    # A/B its overhead (the obs-smoke perf test does exactly that).
+    "events_enabled": True,
+    # Per-process ring capacity; overflow evicts oldest and counts the
+    # drop (exported as ray_tpu_flight_recorder_dropped_total).
+    "event_buffer_size": 8192,
+    # Head-side aggregator retention per job (submitting process).
+    "event_retention_per_job": 50_000,
 }
 
 

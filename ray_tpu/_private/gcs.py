@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from multiprocessing.connection import Listener
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from . import events as _events
 from .config import RayConfig
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from .object_store import ObjectStore
@@ -308,6 +309,22 @@ class GcsServer:
         # (reference: GcsTaskManager task-event store,
         # gcs_task_manager.h:85). Bounded: oldest events roll off.
         self.task_events: deque = deque(maxlen=100_000)
+        # Flight-recorder aggregator (reference: GcsTaskManager's
+        # task-event store generalized to every layer boundary —
+        # events.py): workers/raylets ship ring batches piggybacked on
+        # their existing flushes; this process's own ring (driver +
+        # GCS + spawner share it) drains in-process on reads.
+        self.events = _events.EventAggregator()
+        # The aggregator drains this process's own ring ahead of every
+        # shipped batch it indexes: locally-recorded submission and
+        # scheduling events happen-before the execution events workers
+        # ship for the same tasks, so this keeps per-task transition
+        # order right without cross-process synchronization.
+        self.events.local_recorder = _events.get_recorder()
+        # Last-reported blocked backlog per scheduling class: BLOCKED
+        # sched events record only on change, so an unplaceable class
+        # can't flood the ring at the scheduler pass rate.
+        self._last_blocked: Dict[Any, int] = {}
         # Outstanding flush barriers for read-your-writes state listings
         # (token -> {"need", "got", "ev"}); see _barrier_flush_events.
         self._flush_waits: Dict[int, Dict[str, Any]] = {}
@@ -624,6 +641,10 @@ class GcsServer:
                     w.state = W_IDLE
                     node.pool.add(wid)
                 node_id = node.node_id.binary()
+                _events.record(
+                    _events.WORKER, w.worker_id.hex(), "REGISTERED",
+                    {"pid": w.pid},
+                )
                 self._work.notify_all()
         elif role == "driver" and msg.get("transfer_addr"):
             # Remote driver: its objects live in its own store, served by
@@ -713,6 +734,11 @@ class GcsServer:
                     for orphan in self._orphan_actor_tasks.pop(aid, []):
                         actor.pending.append(orphan)
                 self._pending.append(spec)
+                if _events.enabled():
+                    _events.record(
+                        _events.TASK, spec.task_id.hex(), "QUEUED",
+                        {"depth": len(self._pending)},
+                    )
                 self._work.notify_all()
 
     def _route_actor_task(self, spec: TaskSpec):
@@ -741,6 +767,11 @@ class GcsServer:
                 spec.task_id.binary(), spec.name, "RUNNING",
                 actor.worker_id.binary(),
             )
+            if _events.enabled():
+                _events.record(
+                    _events.TASK, spec.task_id.hex(), "LEASED",
+                    {"worker": actor.worker_id.hex(), "route": "actor"},
+                )
         except ConnectionLost:
             w.inflight.pop(spec.task_id.binary(), None)
             actor.pending.append(spec)
@@ -835,6 +866,7 @@ class GcsServer:
             self._apply_task_done(msg["worker_id"], msg, freed)
             self._work.notify_all()
         self._broadcast_free(freed)
+        self._ingest_peer_events(msg)
 
     def _h_task_done_batch(self, state, msg):
         """Coalesced direct-path completions (one message per worker per
@@ -848,6 +880,39 @@ class GcsServer:
                 self._apply_task_done(wid, item, freed)
             self._work.notify_all()
         self._broadcast_free(freed)
+        self._ingest_peer_events(msg)
+
+    def _ingest_peer_events(self, msg: Dict[str, Any],
+                            source: Optional[str] = None) -> None:
+        """Flight-recorder batch piggybacked on another message
+        (task_done/task_done_batch/node_heartbeat/event_batch)."""
+        items = msg.get("events")
+        dropped = msg.get("events_dropped", 0)
+        if not items and not dropped:
+            return
+        if source is None:
+            wid = msg.get("worker_id")
+            source = (
+                f"worker-{wid.hex()[:12]}"
+                if isinstance(wid, bytes)
+                else str(msg.get("source", "?"))
+            )
+        self.events.ingest(items or [], source, dropped)
+
+    def _h_event_batch(self, state, msg):
+        """Standalone flight-recorder shipment (processes with no other
+        flush to piggyback on)."""
+        self._ingest_peer_events(msg)
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True)
+
+    def _drain_local_events(self) -> None:
+        """This process's own ring (driver + GCS + spawner share it)
+        into the aggregator — read-time, never on a hot path. The ring
+        goes to the FRONT of the indexing backlog: locally-recorded
+        submit-side events happen-before the worker batches a read
+        barrier may have just parked there."""
+        self.events.drain_local_front()
 
     def _apply_task_done(self, wid: bytes, msg: Dict[str, Any],
                          freed: List[bytes]) -> None:
@@ -1325,6 +1390,10 @@ class GcsServer:
                         # Tie the lease to the lessee's connection so a
                         # dead client can't strand leased workers.
                         state.setdefault("held_leases", set()).add(wid)
+                        _events.record(
+                            _events.LEASE, w.worker_id.hex(), "GRANTED",
+                            {"node": node.node_id.hex()[:12]},
+                        )
                         state["peer"].reply(
                             msg, ok=True, worker_id=wid, addr=w.direct_addr
                         )
@@ -1355,6 +1424,7 @@ class GcsServer:
             w = self.workers.get(wid)
             if w is None or w.state != W_LEASED:
                 return
+            _events.record(_events.LEASE, w.worker_id.hex(), "RETURNED")
             node = self.nodes.get(w.node_id.binary())
             if node is not None and w.lease_resources:
                 _release(node.available, w.lease_resources)
@@ -1843,6 +1913,59 @@ class GcsServer:
             idle_nodes=idle_nodes,
         )
 
+    def _h_list_events(self, state, msg):
+        """Flight-recorder read: barrier-flush the workers (their rings
+        piggyback on the done-batcher flush the barrier forces), drain
+        this process's ring, then filter the aggregator."""
+        self._barrier_flush_events(exclude_wid=state.get("worker_id"))
+        self._drain_local_events()
+        items = self.events.list(
+            entity=msg.get("entity"),
+            category=msg.get("category"),
+            job=msg.get("job"),
+            event=msg.get("event"),
+            limit=msg.get("limit", 1000),
+        )
+        state["peer"].reply(msg, ok=True, events=items)
+
+    def _h_set_events_recording(self, state, msg):
+        """Cluster-wide runtime toggle of flight-recorder capture: flip
+        this process (head + driver share the global recorder) and
+        broadcast to every live worker and node daemon, and workers
+        spawned later inherit the current state via their spawn env.
+        No restart — the obs-smoke overhead test A/Bs with this so both
+        windows run in ONE cluster under identical host conditions, and
+        an operator can rule recording out while triaging a perf
+        regression. Remote drivers are the one surface NOT reached:
+        their submission-side recording stays driver-local
+        (RAY_TPU_events_enabled in the driver's own env)."""
+        on = bool(msg.get("enabled", True))
+        _events.get_recorder().enabled = on
+        with self._lock:
+            conns = [
+                w.conn for w in self.workers.values() if w.conn is not None
+            ]
+            conns += [
+                n.conn for n in self.nodes.values() if n.conn is not None
+            ]
+        for conn in conns:
+            try:
+                conn.send({"type": "set_events_recording", "enabled": on})
+            except ConnectionLost:
+                pass
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True, enabled=on)
+
+    def _h_events_summary(self, state, msg):
+        """Derived flight-recorder metrics for the Prometheus scrape:
+        per-phase latency histograms, drop counters, live queue depth."""
+        self._drain_local_events()
+        summary = self.events.summary()
+        with self._lock:
+            summary["queue_depth"] = len(self._pending)
+            summary["queue_classes"] = len(self._pending.classes)
+        state["peer"].reply(msg, ok=True, summary=summary)
+
     def _h_get_task_events(self, state, msg):
         # Timeline/summary reads the same batched deque as list_state:
         # same read-your-writes barrier.
@@ -1902,6 +2025,9 @@ class GcsServer:
         )
 
     def _h_node_heartbeat(self, state, msg):
+        self._ingest_peer_events(
+            msg, source=f"node-{msg['node_id'].hex()[:12]}"
+        )
         with self._lock:
             node = self.nodes.get(msg["node_id"])
             if node is not None:
@@ -3039,10 +3165,31 @@ class GcsServer:
                     deferred.append(spec)  # deps pending: skip, keep going
                 else:  # no capacity / no worker: class blocked this pass
                     q.appendleft(spec)
+                    # Scheduling-decision visibility: a class that
+                    # can't place is the spillback signal. Record only
+                    # when the backlog CHANGES — the scheduler re-probes
+                    # at pass rate and a steady blocked class must not
+                    # flood the ring.
+                    backlog = len(q)
+                    # Only while recording: updating the change-tracker
+                    # with capture off would suppress the BLOCKED signal
+                    # after an operator re-enables it mid-stall.
+                    if (
+                        _events.enabled()
+                        and self._last_blocked.get(key) != backlog
+                    ):
+                        self._last_blocked[key] = backlog
+                        _events.record(
+                            _events.SCHED, repr(key[0]), "BLOCKED",
+                            {"backlog": backlog},
+                        )
                     break
             q.extend(deferred)
             if not q:
                 self._pending.classes.pop(key, None)
+                # A drained class's next stall is a NEW blocked signal;
+                # also keeps the dict bounded by live classes.
+                self._last_blocked.pop(key, None)
             elif dispatched_any:
                 # Round-robin fairness: a class that consumed capacity
                 # this pass goes to the back so a saturated cluster
@@ -3151,6 +3298,15 @@ class GcsServer:
                 spec.task_id.binary(), spec.name, "RUNNING",
                 worker.worker_id.binary(),
             )
+            if _events.enabled():
+                _events.record(
+                    _events.TASK, spec.task_id.hex(), "LEASED",
+                    {
+                        "worker": worker.worker_id.hex(),
+                        "node": node.node_id.hex()[:12],
+                        "route": "gcs",
+                    },
+                )
             return "dispatched"
         except ConnectionLost:
             self._release_task_resources(spec, node.node_id)
@@ -3240,6 +3396,10 @@ class GcsServer:
         wid = WorkerID.from_random()
         w = WorkerHandle(worker_id=wid, node_id=node.node_id, tpu=tpu)
         self.workers[wid.binary()] = w
+        _events.record(
+            _events.WORKER, wid.hex(), "SPAWN_REQUESTED",
+            {"node": node.node_id.hex()[:12], "tpu": tpu},
+        )
         if node.conn is not None:
             # Remote node: its daemon spawns the worker; the worker
             # connects back to us over TCP on its own.
@@ -3258,6 +3418,12 @@ class GcsServer:
         env = {
             "RAY_TPU_WORKER_ID": wid.hex(),
             "PYTHONUNBUFFERED": "1",  # prints reach the log tailer live
+            # Current flight-recorder toggle: a worker spawned after
+            # `events --record off` must not silently resume recording
+            # (RayConfig reads this env override at worker boot).
+            "RAY_TPU_events_enabled": (
+                "1" if _events.get_recorder().enabled else "0"
+            ),
         }
         logdir = os.path.join(self.session_dir, "logs")
         os.makedirs(logdir, exist_ok=True)

@@ -21,6 +21,23 @@ SCAN_INTERVAL_S = 0.25
 DEDUP_WINDOW_S = 5.0
 MAX_BATCH_LINES = 500
 
+# Task-context marker a worker's _TaggedStream (worker_main.py) frames
+# into its stdout: "\x1et=<task_id_hex>\x1e<line>". Lifted out of the
+# line and into the worker tag here, so the dashboard log viewer can
+# correlate a log line to its flight-recorder timeline row while the
+# visible line stays untouched.
+_TASK_MARK = "\x1et="
+
+
+def _tag_line(tag: str, line: str):
+    """(worker_tag, line) with any task marker folded into the tag."""
+    if line.startswith(_TASK_MARK):
+        end = line.find("\x1e", len(_TASK_MARK))
+        if end > 0:
+            tid = line[len(_TASK_MARK):end]
+            return (f"{tag} task={tid[:12]}", line[end + 1:])
+    return (tag, line)
+
 
 class LogMonitor:
     def __init__(
@@ -79,7 +96,7 @@ class LogMonitor:
             for raw in lines:
                 line = raw.decode(errors="replace").rstrip("\r")
                 if line:
-                    entries.append((tag, line))
+                    entries.append(_tag_line(tag, line))
             if len(entries) >= MAX_BATCH_LINES:
                 # Bound message size without losing lines (offsets only
                 # cover bytes actually read): flush and keep scanning.

@@ -19,6 +19,7 @@ import threading
 from multiprocessing import shared_memory, resource_tracker
 from typing import Any, Dict, Optional, Tuple
 
+from . import events as _events
 from . import serialization
 from .ids import ObjectID
 
@@ -84,12 +85,18 @@ class ObjectStore:
 
     def put_serialized(self, object_id: ObjectID, payload, buffers, size) -> str:
         """Write an already-serialized value; returns its location name."""
+        _rec = _events.get_recorder()
         if self._pool is not None:
             view = self._pool.create(object_id.binary(), max(size, 1))
             if view is not None:
                 serialization.write_to(view, payload, buffers)
                 del view
                 self._pool.seal(object_id.binary())
+                if _rec.enabled:
+                    _rec.record(
+                        _events.OBJECT, object_id.hex(), "SEALED",
+                        {"size": size, "loc": "pool"},
+                    )
                 return "pool"
         name = segment_name(object_id)
         shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
@@ -97,6 +104,11 @@ class ObjectStore:
         serialization.write_to(shm.buf, payload, buffers)
         with self._lock:
             self._segments[name] = shm
+        if _rec.enabled:
+            _rec.record(
+                _events.OBJECT, object_id.hex(), "SEALED",
+                {"size": size, "loc": "segment"},
+            )
         return name
 
     def put_packed(self, object_id: ObjectID, blob) -> str:

@@ -12,8 +12,10 @@ done consumer-side in ObjectFetcher.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional
 
+from . import events as _events
 from . import transport
 from .ids import ObjectID
 from .object_store import ObjectStore
@@ -162,7 +164,16 @@ class ObjectFetcher:
         try:
             if self._store.contains(oid):
                 return True
-            return self._pull_chunks(oid, address, timeout)
+            _rec = _events.get_recorder()
+            if not _rec.enabled:
+                return self._pull_chunks(oid, address, timeout)
+            t0 = time.time()
+            ok = self._pull_chunks(oid, address, timeout)
+            _rec.record(
+                _events.TRANSFER, oid.hex(), "PULL",
+                {"ok": ok, "seconds": time.time() - t0, "from": address},
+            )
+            return ok
         finally:
             with self._lock:
                 self._inflight.pop(key, None)

@@ -416,6 +416,20 @@ def main(argv=None) -> int:
 
         out = {
             "results": RESULTS,
+            # One row per benchmark with the raw rate AND its baseline
+            # ratio side by side (null where the reference published no
+            # number), so BENCH_*.json rounds diff directly without
+            # cross-referencing two maps.
+            "per_benchmark": {
+                k: {
+                    "raw": v,
+                    "ratio_vs_baseline": (
+                        round(v / BASELINE[k], 3) if k in BASELINE else None
+                    ),
+                }
+                for k, v in RESULTS.items()
+                if not k.startswith("_")
+            },
             "vs_baseline": {
                 k: round(RESULTS[k] / BASELINE[k], 3)
                 for k in BASELINE

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import events as _events
 from .config import RayConfig
 from .ids import WorkerID
 from .object_store import ObjectStore
@@ -199,6 +200,9 @@ class NodeDaemon:
             # pool; the head finalizes removal once we're quiet
             # (reference: raylet drain — node_manager.h:551).
             self._draining = True
+        elif mtype == "set_events_recording":
+            # Cluster-wide flight-recorder toggle (gcs broadcast).
+            _events.get_recorder().enabled = bool(msg.get("enabled", True))
         elif mtype == "shutdown":
             self.shutdown()
 
@@ -209,6 +213,12 @@ class NodeDaemon:
             "RAY_TPU_NODE_NS": self.node_ns,
             "PYTHONUNBUFFERED": "1",  # prints reach the log tailer live
             "RAY_TPU_NODE_ID": self.node_id.hex(),
+            # Current flight-recorder toggle (this daemon tracks the
+            # cluster-wide broadcast): a worker spawned after
+            # `events --record off` must not silently resume recording.
+            "RAY_TPU_events_enabled": (
+                "1" if _events.get_recorder().enabled else "0"
+            ),
         }
         if msg.get("local_only"):
             env["RAY_TPU_LOCAL_ONLY"] = "1"
@@ -410,6 +420,11 @@ class NodeDaemon:
                             "tpu": wants_tpu, "chip": chip,
                         }
                         spawn_wid = w
+            if granted is not None:
+                _events.record(
+                    _events.LEASE, granted[0].hex(), "GRANTED",
+                    {"local": True},
+                )
             try:
                 if granted is not None:
                     peer.reply(msg, ok=True, worker_id=granted[0],
@@ -441,6 +456,9 @@ class NodeDaemon:
                 self._leased_count[
                     "tpu" if rec.get("tpu") else "cpu"
                 ] -= 1
+                _events.record(
+                    _events.LEASE, wid.hex(), "RETURNED", {"local": True}
+                )
             proc = rec.get("proc") if rec else None
         if proc is not None and proc.poll() is not None:
             with self._lock:
@@ -457,18 +475,26 @@ class NodeDaemon:
         interval = RayConfig.health_check_period_ms / 1000.0
         while not self._shutdown.wait(interval):
             try:
-                self.conn.send(
-                    {
-                        "type": "node_heartbeat",
-                        "node_id": self.node_id,
-                        "local_cpus_in_use": float(
-                            self._leased_count["cpu"]
-                        ),
-                        "local_tpus_in_use": float(
-                            self._leased_count["tpu"]
-                        ),
-                    }
-                )
+                msg = {
+                    "type": "node_heartbeat",
+                    "node_id": self.node_id,
+                    "local_cpus_in_use": float(
+                        self._leased_count["cpu"]
+                    ),
+                    "local_tpus_in_use": float(
+                        self._leased_count["tpu"]
+                    ),
+                }
+                # Flight-recorder piggyback: this daemon's ring (local
+                # lease grants, fork lifecycle) rides the heartbeat
+                # that already flows — no extra message or timer.
+                rec = _events.get_recorder()
+                ev_items, ev_dropped = rec.attach(msg)
+                try:
+                    self.conn.send(msg)
+                except ConnectionLost:
+                    rec.count_lost(ev_items, ev_dropped)
+                    raise
             except ConnectionLost:
                 # Head may be restarting. The conn's own on_close drives
                 # the rejoin; calling it here too is safe (reentrancy

@@ -32,7 +32,10 @@ class ForkedProc:
 
     def __init__(self, pid: Optional[int] = None,
                  on_fail: Optional[callable] = None,
-                 fallback: Optional[callable] = None):
+                 fallback: Optional[callable] = None,
+                 entity: str = ""):
+        # Flight-recorder identity (the worker id this fork is for).
+        self._entity = entity
         self._pid = pid
         self._resolved = threading.Event()
         if pid is not None:
@@ -55,6 +58,11 @@ class ForkedProc:
 
     def _resolve(self, pid: int) -> None:
         self._pid = pid
+        from . import events as _events
+
+        _events.record(
+            _events.WORKER, self._entity, "FORKED", {"pid": pid}
+        )
         self._resolved.set()
         sig, self._pending_signal = self._pending_signal, None
         if sig is not None:
@@ -80,6 +88,9 @@ class ForkedProc:
                 self._popen = child  # direct child: reap via Popen.poll
                 self._resolve(child.pid)
                 return
+        from . import events as _events
+
+        _events.record(_events.WORKER, self._entity, "FORK_FAILED")
         self._returncode = 1
         self._resolved.set()
         if self._on_fail is not None:
@@ -220,6 +231,12 @@ class WorkerSpawner:
     def spawn(self, env: Dict[str, str], log_path: str, tpu: bool = False,
               on_fail=None):
         """Returns a Popen-shaped handle (ForkedProc or Popen)."""
+        from . import events as _events
+
+        wid_hex = env.get("RAY_TPU_WORKER_ID", "")
+        _events.record(
+            _events.WORKER, wid_hex, "FORK_REQUESTED", {"tpu": tpu}
+        )
         if not tpu:
             with self._lock:
                 z = self._ensure_zygote()
@@ -236,6 +253,7 @@ class WorkerSpawner:
                             fallback=lambda e=dict(env): self._cold_spawn(
                                 e, log_path, tpu
                             ),
+                            entity=wid_hex,
                         )
                         self._awaiting.append(proc)
                         z.stdin.write((json.dumps(req) + "\n").encode())
@@ -266,7 +284,7 @@ class WorkerSpawner:
             full_env["JAX_PLATFORMS"] = "cpu"
         out = open(log_path, "ab")
         try:
-            return subprocess.Popen(
+            proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu._private.worker_main"],
                 env=full_env,
                 stdout=out,
@@ -274,6 +292,13 @@ class WorkerSpawner:
             )
         finally:
             out.close()
+        from . import events as _events
+
+        _events.record(
+            _events.WORKER, full_env.get("RAY_TPU_WORKER_ID", ""),
+            "FORKED", {"pid": proc.pid, "cold": True},
+        )
+        return proc
 
     def shutdown(self) -> None:
         with self._lock:

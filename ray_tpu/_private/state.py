@@ -16,10 +16,46 @@ def task_events() -> List[Dict[str, Any]]:
     return reply["events"]
 
 
+def list_cluster_events(
+    entity: Optional[str] = None,
+    category: Optional[str] = None,
+    job: Optional[str] = None,
+    event: Optional[str] = None,
+    limit: int = 1000,
+) -> List[Dict[str, Any]]:
+    """Flight-recorder transitions from the head aggregator
+    (events.py); the read barrier-flushes worker rings first."""
+    from .worker import global_client
+
+    reply = global_client().state_read(
+        {
+            "type": "list_events",
+            "entity": entity,
+            "category": category,
+            "job": job,
+            "event": event,
+            "limit": limit,
+        }
+    )
+    if not reply.get("ok"):
+        raise RuntimeError("list_events failed")
+    return reply["events"]
+
+
+def task_transitions(task_id_hex: str) -> List[Dict[str, Any]]:
+    """One task's lifecycle transitions (SUBMITTED → ... → SEALED),
+    time-ordered."""
+    return list_cluster_events(
+        entity=task_id_hex, category="task", limit=10_000
+    )
+
+
 def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
     """Chrome-trace (chrome://tracing / perfetto) export of task
     execution. RUNNING→FINISHED/FAILED pairs become complete ("X")
-    events laid out per worker."""
+    events laid out per worker, PLUS one stitched row per task from
+    the flight recorder: the submit→queue→lease→fork→exec→seal
+    phases laid end to end (pid "tasks")."""
     events = task_events()
     starts: Dict[str, Dict[str, Any]] = {}
     trace: List[Dict[str, Any]] = []
@@ -45,6 +81,16 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
                     },
                 }
             )
+    try:
+        from . import events as _events
+
+        recorder_events = list_cluster_events(
+            category="task", limit=100_000
+        )
+        for slices in _events.stitch_task_phases(recorder_events).values():
+            trace.extend(slices)
+    except Exception:  # noqa: BLE001 - recorder disabled or old head
+        pass
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -227,6 +227,14 @@ def shutdown():
     with _global.lock:
         if _global.client is not None and _global.mode == DRIVER_MODE:
             try:
+                # Ship this driver's flight-recorder ring before the
+                # connection closes: an external driver's submission
+                # events otherwise die with the process and its tasks
+                # lose their submit/queue/lease phases.
+                _global.client.flush_runtime_events()
+            except Exception:  # noqa: BLE001
+                pass
+            try:
                 _global.client.close()
             except Exception:
                 pass

@@ -39,6 +39,7 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from . import events as _events
 from . import serialization
 from .client import CoreClient
 from .config import RayConfig
@@ -82,6 +83,46 @@ def _spec_from_frame(frame) -> TaskSpec:
     s.concurrency_groups = None
     s.concurrency_group = frame[8] if len(frame) > 8 else None
     return s
+
+
+class _TaggedStream:
+    """Prefix lines printed while a task executes with an \\x1e-framed
+    task marker. The log monitor lifts the marker out of the line and
+    into the worker tag (``<worker> task=<id>``), so the dashboard log
+    viewer can correlate a log line to its timeline row without the
+    visible line changing."""
+
+    def __init__(self, base):
+        self._base = base
+        self._at_start = True
+        # Concurrency groups / user threads share this stream; the
+        # line-start bookkeeping must not interleave mid-write or a
+        # marker lands mid-line, where the log monitor won't lift it.
+        self._wlock = threading.Lock()
+
+    def write(self, s):
+        if not s:
+            return 0
+        tid = _events.current_task_context()
+        with self._wlock:
+            if tid is None:
+                self._at_start = s.endswith("\n")
+                return self._base.write(s)
+            marker = "\x1et=" + tid + "\x1e"
+            out = []
+            for chunk in s.splitlines(keepends=True):
+                if self._at_start:
+                    out.append(marker)
+                out.append(chunk)
+                self._at_start = chunk.endswith("\n")
+            self._base.write("".join(out))
+        return len(s)
+
+    def flush(self):
+        self._base.flush()
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
 
 
 class _DoneBatcher:
@@ -133,20 +174,25 @@ class _DoneBatcher:
         with self._send_lock:
             with self._lock:
                 items, self._items = self._items, []
-            if not items:
+            # Flight-recorder piggyback: the ring ships on the flush
+            # that already exists instead of its own timer/message
+            # (reference: task events batch with the state updates,
+            # task_event_buffer.h).
+            rec = _events.get_recorder()
+            msg = {
+                "type": "task_done_batch",
+                "worker_id": self._client.worker_id.binary(),
+                "items": items,
+            }
+            ev_items, ev_dropped = rec.attach(msg)
+            if not items and not ev_items and not ev_dropped:
                 return
             from .protocol import ConnectionLost
 
             try:
-                self._client.send(
-                    {
-                        "type": "task_done_batch",
-                        "worker_id": self._client.worker_id.binary(),
-                        "items": items,
-                    }
-                )
+                self._client.send(msg)
             except ConnectionLost:
-                pass
+                rec.count_lost(ev_items, ev_dropped)
 
     def _loop(self) -> None:
         # Park until work arrives — an idle worker must cost ZERO
@@ -192,6 +238,7 @@ class WorkerRuntime:
         self._aio_loop: Optional[asyncio.AbstractEventLoop] = None
         self._done = threading.Event()
         self._done_batcher = _DoneBatcher(client)
+        self._wid_hex = client.worker_id.hex()
         # Serializes execution across the main loop (GCS-routed tasks)
         # and direct-conn reader threads (inline fast calls): serial
         # workers run exactly one task at a time no matter which path
@@ -270,7 +317,12 @@ class WorkerRuntime:
 
         _, req_id, tid, fid, method, args_blob, nret, aid = frame[:8]
         name = method or "task"
+        _rec = _events.get_recorder()
+        t_fork = time.time() if _rec.enabled else 0.0
+        t_start = 0.0
+        tid_hex = tid.hex()
         with self._lock_for(aid):
+            _events.set_task_context(tid_hex)
             try:
                 if aid is not None:
                     fn = getattr(self._actor_for(aid), method)
@@ -281,6 +333,8 @@ class WorkerRuntime:
                         fn = cloudpickle.loads(blob)
                         self.fn_cache[fid] = fn
                     name = getattr(fn, "__name__", "task")
+                if _rec.enabled:
+                    t_start = time.time()
                 if args_blob == _EMPTY_ARGS_BLOB:
                     value = fn()
                 else:
@@ -297,6 +351,9 @@ class WorkerRuntime:
                 exc = None
             except BaseException as e:  # noqa: BLE001
                 value, exc = None, e
+            finally:
+                _events.set_task_context(None)
+        t_end = time.time() if _rec.enabled else 0.0
         error_blob = None
         tuple_results = None
         dict_results = []
@@ -357,6 +414,22 @@ class WorkerRuntime:
                 "error": error_blob,
             }
         )
+        # t_fork truthy too: recording may have been toggled on
+        # mid-execution, and a half-captured span (0.0 boundaries)
+        # would poison the phase histograms with epoch-sized phases.
+        if _rec.enabled and t_fork:
+            # One append carrying all four execution boundaries; the
+            # head expands it into FORKED/EXEC_START/EXEC_END/SEALED.
+            attrs = {
+                "t_fork": t_fork,
+                "t_start": t_start or t_fork,
+                "t_end": t_end,
+                "t_seal": time.time(),
+                "worker": self._wid_hex,
+            }
+            if error_blob is not None:
+                attrs["error"] = True
+            _rec.record(_events.TASK, tid_hex, "EXEC_SPAN", attrs)
 
     # -------------------------------------------------------------- resolve
 
@@ -851,17 +924,38 @@ class WorkerRuntime:
         self.client.send(msg)
 
     def _execute(self, spec: TaskSpec, origin=None):
+        _rec = _events.get_recorder()
+        t_fork = time.time() if _rec.enabled else 0.0
+        _events.set_task_context(spec.task_id.hex())
         try:
             value = self._run_user_code(spec)
             exc = None
         except BaseException as e:  # noqa: BLE001
             value, exc = None, e
+        finally:
+            _events.set_task_context(None)
+        t_end = time.time() if _rec.enabled else 0.0
         if spec.num_returns == -1:
             # Failures before iteration (bad args, fetch error) must
             # still end the stream or consumers park forever.
             self._stream_results(spec, value, origin, exc=exc)
             return
         self._report_done(spec, value, exc, origin)
+        # t_fork truthy too: a mid-execution toggle-on must not ship a
+        # half-captured span (0.0 boundaries poison the histograms).
+        if _rec.enabled and t_fork:
+            attrs = {
+                "t_fork": t_fork,
+                "t_start": t_fork,
+                "t_end": t_end,
+                "t_seal": time.time(),
+                "worker": self._wid_hex,
+            }
+            if exc is not None:
+                attrs["error"] = True
+            _rec.record(
+                _events.TASK, spec.task_id.hex(), "EXEC_SPAN", attrs
+            )
 
     # ------------------------------------------------------------------- loop
 
@@ -911,6 +1005,21 @@ def main():
     address = os.environ["RAY_TPU_SESSION_ADDR"]
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    # Flight-recorder toggle state at spawn time. Read explicitly
+    # rather than via RayConfig: zygote-forked workers inherit a
+    # config initialized in the zygote parent BEFORE this env var
+    # existed, and a worker spawned after `events --record off` must
+    # not silently resume recording.
+    _ev_env = os.environ.get("RAY_TPU_events_enabled")
+    if _ev_env is not None:
+        _events.get_recorder().enabled = _ev_env.lower() in (
+            "1", "true", "yes",
+        )
+    # Task-context log tagging (satellite of the flight recorder): user
+    # prints gain an invisible marker the log monitor turns into a
+    # worker tag suffix.
+    sys.stdout = _TaggedStream(sys.stdout)
+    sys.stderr = _TaggedStream(sys.stderr)
 
     # The queue exists before the connection: the GCS may push a task the
     # instant our hello registers, on the reader thread.
@@ -975,6 +1084,11 @@ def main():
                     )
                 except Exception:  # noqa: BLE001
                     pass
+        elif t == "set_events_recording":
+            # Cluster-wide flight-recorder toggle (gcs broadcast).
+            from . import events as _ev
+
+            _ev.get_recorder().enabled = bool(msg.get("enabled", True))
         elif t == "dump_stacks":
             # Live profiling hook (reference: dashboard py-spy capture):
             # format every thread's stack right here on the reader
@@ -1121,6 +1235,16 @@ def main():
         pstats.Stats(_prof, stream=s).sort_stats("cumulative").print_stats(15)
         print(s.getvalue())
     rt_holder["boot_client"] = client
+    try:
+        _events.record(
+            _events.WORKER, worker_id.hex(), "BOOT",
+            {
+                "pid": os.getpid(),
+                "spawned_at": float(_spawned_at) if _spawned_at else None,
+            },
+        )
+    except (TypeError, ValueError):
+        pass
     if _spawned_at and os.environ.get("RAY_TPU_BOOT_TRACE"):
         # Boot latency: spawn request -> registered. The spawn path is
         # the actor-creation throughput ceiling; this line makes it

@@ -89,6 +89,8 @@ class DashboardActor:
         app.router.add_post(
             "/api/workflow/events/{key:.+}", self._workflow_event
         )
+        app.router.add_get("/api/events", self._events)
+        app.router.add_get("/api/events_summary", self._events_summary)
         app.router.add_get("/api/task/{task_id}", self._task_detail)
         app.router.add_get("/api/actor/{actor_id}", self._actor_detail)
         app.router.add_get("/api/jobs", self._jobs)
@@ -395,6 +397,42 @@ class DashboardActor:
             lambda: JobSubmissionClient().get_job_logs(jid)
         )
         return web.Response(text=text, content_type="text/plain")
+
+    # ------------------------------------------------------------- events
+    async def _events(self, request):
+        """Flight-recorder feed (events.py): runtime transitions for
+        the timeline view, filterable by task id / category."""
+        import asyncio
+
+        from aiohttp import web
+
+        from ..util.state import list_cluster_events
+
+        q = request.query
+        try:
+            limit = int(q.get("limit", "500"))
+        except ValueError:
+            limit = 500
+        events = await asyncio.to_thread(
+            list_cluster_events,
+            entity=q.get("task") or None,
+            category=q.get("category") or None,
+            limit=limit,
+        )
+        return web.json_response({"events": events})
+
+    async def _events_summary(self, request):
+        """Derived flight-recorder metrics as JSON: per-phase latency
+        histograms, drop counters, queue depth — the same numbers the
+        /metrics Prometheus series are built from."""
+        import asyncio
+
+        from aiohttp import web
+
+        from ..util.state import summarize_events
+
+        summary = await asyncio.to_thread(summarize_events)
+        return web.json_response({"summary": summary})
 
     # --------------------------------------------------------- drill-down
     async def _task_detail(self, request):

@@ -229,6 +229,43 @@ def cmd_timeline(args):
     print(f"wrote {args.output} (open in chrome://tracing or perfetto)")
 
 
+def cmd_events(args):
+    """Flight-recorder transitions (submission → scheduling → lease →
+    fork → exec → seal, plus worker/lease/object/transfer lifecycle)."""
+    _connect()
+    from ray_tpu.util.state import list_cluster_events
+
+    if args.record is not None:
+        from ray_tpu.util.state import set_events_recording
+
+        set_events_recording(args.record == "on")
+        print(f"flight recorder: recording {args.record}")
+        return
+
+    events = list_cluster_events(
+        entity=args.task,
+        category="task" if args.task else args.category,
+        limit=args.limit,
+    )
+    if args.json:
+        print(json.dumps(events, indent=2, default=str))
+        return
+    rows = [
+        {
+            "time": f"{e['timestamp']:.6f}",
+            "category": e["category"],
+            "event": e["event"],
+            "entity": (e.get("entity") or "")[:16],
+            "source": e.get("source", ""),
+            "attrs": json.dumps(e.get("attrs") or {}, default=str),
+        }
+        for e in events
+    ]
+    _print_table(
+        rows, ["time", "category", "event", "entity", "source", "attrs"]
+    )
+
+
 def cmd_memory(args):
     _connect()
     from ray_tpu.util.state import list_objects
@@ -389,6 +426,22 @@ def main(argv=None):
     sp = sub.add_parser("timeline", help="dump chrome trace")
     sp.add_argument("-o", "--output", default="ray_tpu_timeline.json")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "events", help="flight-recorder runtime events"
+    )
+    sp.add_argument("--task", default=None, help="task id (hex) filter")
+    sp.add_argument(
+        "--category", default=None,
+        help="category filter (task/worker/lease/object/transfer/sched)",
+    )
+    sp.add_argument("--limit", type=int, default=200)
+    sp.add_argument("--json", action="store_true")
+    sp.add_argument(
+        "--record", choices=("on", "off"), default=None,
+        help="toggle flight-recorder capture cluster-wide",
+    )
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("memory", help="object store contents")
     sp.add_argument("--limit", type=int, default=100)

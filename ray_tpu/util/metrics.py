@@ -160,11 +160,23 @@ def _prom_name(name: str) -> str:
     return ("_" + s) if s and s[0].isdigit() else s
 
 
+def _escape_label_value(v) -> str:
+    # Exposition format: label values escape backslash, double-quote
+    # AND newline (a raw newline splits the sample line and corrupts
+    # the whole scrape).
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _prom_labels(tags: Dict[str, str]) -> str:
     if not tags:
         return ""
     inner = ",".join(
-        f'{_prom_name(k)}="{str(v).replace(chr(92), chr(92)*2).replace(chr(34), chr(92) + chr(34))}"'
+        f'{_prom_name(k)}="{_escape_label_value(v)}"'
         for k, v in sorted(tags.items())
     )
     return "{" + inner + "}"
@@ -287,6 +299,68 @@ def core_runtime_snapshot() -> Dict[str, Dict]:
         "series": [
             {"tags": {"type": t}, "value": c}
             for t, c in sorted(counts.items())
+        ],
+    }
+    out.update(flight_recorder_snapshot(client))
+    return out
+
+
+def flight_recorder_snapshot(client=None) -> Dict[str, Dict]:
+    """Derived flight-recorder series (events.py aggregator): per-phase
+    task latency histograms, event/drop counters, live pending-queue
+    depth. Drops are the load-bearing series — ring overflow is counted
+    at the source and summed here, never silently lost."""
+    if client is None:
+        from .._private.worker import global_client
+
+        client = global_client()
+    reply = client.request({"type": "events_summary"})
+    if not reply.get("ok"):
+        return {}
+    s = reply["summary"]
+    out: Dict[str, Dict] = {}
+    out["ray_tpu_pending_tasks"] = {
+        "kind": "gauge",
+        "description": "tasks in the head scheduling queue",
+        "series": [{"tags": {}, "value": s.get("queue_depth", 0)}],
+    }
+    out["ray_tpu_pending_scheduling_classes"] = {
+        "kind": "gauge",
+        "description": "distinct scheduling classes with queued tasks",
+        "series": [{"tags": {}, "value": s.get("queue_classes", 0)}],
+    }
+    out["ray_tpu_flight_recorder_events_total"] = {
+        "kind": "counter",
+        "description": "flight-recorder transitions ingested by category",
+        "series": [
+            {"tags": {"category": c}, "value": n}
+            for c, n in sorted(s.get("totals", {}).items())
+        ],
+    }
+    # Always emit at least one sample so "no drops" is an observable 0,
+    # not an absent series.
+    drops = s.get("drops", {}) or {"": 0}
+    out["ray_tpu_flight_recorder_dropped_total"] = {
+        "kind": "counter",
+        "description": "flight-recorder events dropped (ring overflow "
+        "+ retention eviction) by source",
+        "series": [
+            {"tags": {"source": src} if src else {}, "value": n}
+            for src, n in sorted(drops.items())
+        ],
+    }
+    out["ray_tpu_task_phase_seconds"] = {
+        "kind": "histogram",
+        "description": "per-phase task latency "
+        "(submit/queue/lease/fork/exec/seal)",
+        "boundaries": list(s.get("phase_boundaries", [])),
+        "series": [
+            {
+                "tags": {"phase": p},
+                "sum": s.get("phase_sums", {}).get(p, 0.0),
+                "counts": c,
+            }
+            for p, c in sorted(s.get("phase_counts", {}).items())
         ],
     }
     return out

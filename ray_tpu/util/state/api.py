@@ -47,6 +47,48 @@ def list_placement_groups(filters=None, limit: int = 1000):
     return _list("placement_groups", limit, filters)
 
 
+def list_cluster_events(
+    entity: Optional[str] = None,
+    category: Optional[str] = None,
+    job: Optional[str] = None,
+    event: Optional[str] = None,
+    limit: int = 1000,
+) -> List[Dict[str, Any]]:
+    """Flight-recorder transitions (reference: `ray list cluster-events`
+    over the GCS task-event store; here events.py covers every layer
+    boundary — submission, scheduling, lease, fork, exec, seal)."""
+    from ..._private.state import list_cluster_events as _impl
+
+    return _impl(
+        entity=entity, category=category, job=job, event=event,
+        limit=limit,
+    )
+
+
+def summarize_events() -> Dict[str, Any]:
+    """Derived flight-recorder metrics: per-phase latency histograms,
+    drop counters, live pending-queue depth."""
+    from ..._private.worker import global_client
+
+    reply = global_client().request({"type": "events_summary"})
+    if not reply.get("ok"):
+        raise RuntimeError("events_summary failed")
+    return reply["summary"]
+
+
+def set_events_recording(enabled: bool) -> None:
+    """Toggle flight-recorder capture cluster-wide at runtime (head +
+    every worker and node daemon), without a restart. Already-recorded
+    events stay readable; only new captures stop."""
+    from ..._private.worker import global_client
+
+    reply = global_client().request(
+        {"type": "set_events_recording", "enabled": bool(enabled)}
+    )
+    if not reply.get("ok"):
+        raise RuntimeError("set_events_recording failed")
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Per-function-name counts by state (reference:
     util/state/api.py summarize_tasks:1365)."""

@@ -1,9 +1,11 @@
 """Observability depth: Prometheus exposition, metrics timeseries,
-dashboard log viewer, live worker stack profiling.
+dashboard log viewer, live worker stack profiling, and the
+flight-recorder event pipeline (_private/events.py).
 
 Models the reference's dashboard/metrics-agent surface
 (dashboard/modules/, _private/metrics_agent.py,
-reporter/profile_manager.py).
+reporter/profile_manager.py) plus the task-event path
+(task_event_buffer.h → gcs_task_manager.h → timeline).
 """
 import json
 import time
@@ -50,6 +52,32 @@ def test_prometheus_text_format():
     assert 'busy{node="n1"} 2.0' in text
     # Invalid chars sanitized to underscores.
     assert "weird_name_1 7" in text
+
+
+def test_prometheus_label_value_escaping():
+    """Exposition format requires backslash, quote AND newline escaped
+    in label values — a raw newline splits the sample line and corrupts
+    the whole scrape (regression: newline was passed through)."""
+    from ray_tpu.util.metrics import prometheus_text
+
+    snap = {
+        "m": {
+            "kind": "gauge",
+            "description": "",
+            "series": [
+                {
+                    "tags": {"err": 'a"b\\c\nd'},
+                    "value": 1.0,
+                }
+            ],
+        },
+    }
+    text = prometheus_text(snap)
+    assert '\\n' in text
+    assert 'm{err="a\\"b\\\\c\\nd"} 1.0' in text
+    # Every sample stays on one physical line.
+    for line in text.splitlines():
+        assert line.startswith(("#", "m")) or not line
 
 
 def test_metrics_endpoint_serves_user_and_core(cluster):
@@ -203,3 +231,345 @@ def test_sampling_profile_folded_stacks(cluster):
     # The hot loop dominates the samples.
     assert "spin_hot_loop_marker" in folded
     ray_tpu.get(ref, timeout=60)
+
+
+# ---------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_ring_overflow_drop_accounting():
+    """Overflow evicts oldest, counts every drop, and the counter
+    resets per drain so batches never double-count."""
+    from ray_tpu._private.events import TASK, FlightRecorder
+
+    rec = FlightRecorder(capacity=4, enabled=True, source="unit")
+    for i in range(10):
+        rec.record(TASK, f"t{i}", "SUBMITTED")
+    assert len(rec) == 4
+    items, dropped = rec.drain()
+    assert len(items) == 4 and dropped == 6
+    # Oldest evicted: the survivors are the newest four.
+    assert [it[3] for it in items] == ["t6", "t7", "t8", "t9"]
+    # Drain is destructive and resets the drop counter.
+    items, dropped = rec.drain()
+    assert items == [] and dropped == 0
+
+
+def test_flight_recorder_disabled_records_nothing():
+    from ray_tpu._private.events import TASK, FlightRecorder
+
+    rec = FlightRecorder(capacity=4, enabled=False)
+    rec.record(TASK, "t", "SUBMITTED")
+    assert len(rec) == 0 and rec.dropped == 0
+
+
+def test_aggregator_span_expansion_and_phase_histograms():
+    """One SUBMIT_SPAN + one EXEC_SPAN (the compact hot-path form)
+    expand into all seven transitions and feed the six phase
+    histograms."""
+    from ray_tpu._private.events import (
+        TASK,
+        TASK_PHASES,
+        TASK_TRANSITIONS,
+        EventAggregator,
+    )
+
+    agg = EventAggregator(per_job_cap=100)
+    t0 = 1000.0
+    agg.ingest(
+        [
+            (t0, 1.0, TASK, "tid1", "SUBMIT_SPAN",
+             {"t_submit": t0, "t_queue": t0 + 1, "t_lease": t0 + 2}),
+            (t0 + 6, 2.0, TASK, "tid1", "EXEC_SPAN",
+             {"t_fork": t0 + 3, "t_start": t0 + 4, "t_end": t0 + 5,
+              "t_seal": t0 + 6, "worker": "w1"}),
+        ],
+        source="unit",
+    )
+    names = [e["event"] for e in agg.task_transitions("tid1")]
+    assert names == list(TASK_TRANSITIONS)
+    summary = agg.summary()
+    for phase in TASK_PHASES:
+        assert sum(summary["phase_counts"][phase]) == 1
+        assert summary["phase_sums"][phase] == pytest.approx(1.0)
+
+
+def test_aggregator_per_job_retention_counts_evictions():
+    from ray_tpu._private.events import TASK, EventAggregator
+
+    agg = EventAggregator(per_job_cap=5)
+    agg.ingest(
+        [(float(i), float(i), TASK, f"t{i}", "SUBMITTED", None)
+         for i in range(12)],
+        source="jobA",
+    )
+    summary = agg.summary()
+    assert summary["jobs"]["jobA"] == 5
+    assert summary["drops"]["jobA"] == 7  # evictions, never silent
+    # Ring drops from the shipping batch land beside retention drops.
+    agg.ingest([], source="jobA", ring_dropped=3)
+    assert agg.summary()["drops"]["jobA"] == 10
+
+
+def test_aggregator_merges_local_ring_before_shipped_batches():
+    """The driver/head SUBMIT_SPAN sits in the process-local ring while
+    the worker's EXEC_SPAN ships on the next done-batch flush; the
+    aggregator must drain the local ring ahead of shipped batches or
+    every task's submit/queue/lease phases collapse to zero width and
+    an orphan open-task entry leaks per task."""
+    from ray_tpu._private.events import (
+        TASK,
+        TASK_PHASES,
+        EventAggregator,
+        FlightRecorder,
+    )
+
+    rec = FlightRecorder(capacity=100, enabled=True, source="driver")
+    agg = EventAggregator(per_job_cap=100)
+    agg.local_recorder = rec
+    t0 = 1000.0
+    rec.record(
+        TASK, "tid", "SUBMIT_SPAN",
+        {"t_submit": t0, "t_queue": t0 + 1, "t_lease": t0 + 2},
+    )
+    agg.ingest(
+        [(t0 + 6, 0.0, TASK, "tid", "EXEC_SPAN",
+          {"t_fork": t0 + 3, "t_start": t0 + 4, "t_end": t0 + 5,
+           "t_seal": t0 + 6, "worker": "w"})],
+        source="worker-1",
+    )
+    summary = agg.summary()
+    for phase in TASK_PHASES:
+        assert summary["phase_sums"][phase] == pytest.approx(1.0), phase
+    assert not agg._open  # sealed and fully merged, no orphan
+
+
+def test_aggregator_list_nonpositive_limit_returns_nothing():
+    """limit=0 must not invert into 'everything' via a -0 slice (the
+    dashboard passes user-supplied limits straight through)."""
+    from ray_tpu._private.events import TASK, EventAggregator
+
+    agg = EventAggregator(per_job_cap=10)
+    agg.ingest([(1.0, 0.0, TASK, "t", "SUBMITTED", None)], source="j")
+    assert agg.list(limit=0) == []
+    assert agg.list(limit=-5) == []
+    assert len(agg.list(limit=10)) == 1
+
+
+def test_stitch_clamps_cross_process_clock_skew():
+    """A worker wall clock behind the head's must not yield negative
+    phase durations — boundaries clamp monotone."""
+    from ray_tpu._private.events import TASK_PHASES, stitch_task_phases
+
+    evs = [
+        {"category": "task", "entity": "t", "event": e, "timestamp": ts}
+        for e, ts in (
+            ("SUBMITTED", 100.0),
+            ("QUEUED", 100.5),
+            ("LEASED", 101.0),
+            ("FORKED", 100.2),  # skewed: behind the lease timestamp
+            ("EXEC_START", 100.3),
+            ("EXEC_END", 102.0),
+            ("SEALED", 102.1),
+        )
+    ]
+    rows = stitch_task_phases(evs)["t"]
+    assert [r["name"] for r in rows] == list(TASK_PHASES)
+    for a, b in zip(rows, rows[1:]):
+        assert a["dur"] >= 0
+        assert b["ts"] == pytest.approx(a["ts"] + a["dur"])
+
+
+def test_task_timeline_six_phases_e2e(cluster, tmp_path):
+    """A 3-task run yields a valid Chrome trace with one stitched row
+    per task: six phases, monotonically ordered and contiguous; the
+    `ray_tpu events --task` surface returns the same transitions."""
+    from ray_tpu._private.events import TASK_PHASES, TASK_TRANSITIONS
+    from ray_tpu._private.state import task_transitions, timeline
+    from ray_tpu.util.state import list_cluster_events
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get([f.remote(i) for i in range(3)]) == [0, 2, 4]
+
+    deadline = time.time() + 20
+    tids = []
+    while time.time() < deadline:
+        evs = list_cluster_events(category="task", limit=10_000)
+        by = {}
+        for e in evs:
+            by.setdefault(e["entity"], set()).add(e["event"])
+        tids = [
+            t for t, names in by.items()
+            if set(TASK_TRANSITIONS) <= names
+        ]
+        if len(tids) >= 3:
+            break
+        time.sleep(0.3)
+    assert len(tids) >= 3, f"complete lifecycles: {len(tids)}"
+
+    out = tmp_path / "trace.json"
+    timeline(str(out))
+    trace = json.loads(out.read_text())  # valid Chrome trace JSON
+    assert isinstance(trace, list)
+    by_task = {}
+    for row in trace:
+        if row.get("cat") == "task_phase":
+            by_task.setdefault(row["args"]["task_id"], []).append(row)
+    for tid in tids:
+        rows = by_task[tid]
+        assert [r["name"] for r in rows] == list(TASK_PHASES)
+        for a, b in zip(rows, rows[1:]):
+            assert a["dur"] >= 0
+            # Contiguous + monotone: each phase starts where the
+            # previous ended.
+            assert b["ts"] == pytest.approx(a["ts"] + a["dur"])
+
+    # Same transitions through the per-task read the CLI uses.
+    names = [e["event"] for e in task_transitions(tids[0])]
+    assert set(TASK_TRANSITIONS) <= set(names)
+    ts = [e["timestamp"] for e in task_transitions(tids[0])]
+    assert ts == sorted(ts)
+
+
+def test_events_cli_lists_task_transitions(cluster, monkeypatch, capsys):
+    from ray_tpu._private.events import TASK_TRANSITIONS
+    from ray_tpu.scripts import cli
+    from ray_tpu.util.state import list_cluster_events
+
+    @ray_tpu.remote
+    def g():
+        return 1
+
+    ray_tpu.get(g.remote())
+    deadline = time.time() + 20
+    tid = None
+    while time.time() < deadline and tid is None:
+        for e in list_cluster_events(category="task", event="SEALED"):
+            tid = e["entity"]
+        if tid is None:
+            time.sleep(0.3)
+    assert tid is not None
+    monkeypatch.setattr(cli, "_connect", lambda: None)
+    cli.main(["events", "--task", tid])
+    table = capsys.readouterr().out
+    for name in ("SUBMITTED", "EXEC_START", "SEALED"):
+        assert name in table
+    cli.main(["events", "--task", tid, "--json"])
+    rows = json.loads(capsys.readouterr().out)
+    assert {r["event"] for r in rows} >= set(TASK_TRANSITIONS[:1])
+
+
+def test_event_drops_exported_as_prometheus_counter(cluster):
+    """Deliberate ring overflow: the drop count ships with the batch,
+    lands in the aggregator, and surfaces as a Prometheus counter —
+    never silently lost."""
+    from ray_tpu._private import events as ev
+    from ray_tpu._private.worker import global_client
+    from ray_tpu.util.metrics import (
+        flight_recorder_snapshot,
+        prometheus_text,
+    )
+    from ray_tpu.util.state import summarize_events
+
+    rec = ev.FlightRecorder(capacity=4, enabled=True, source="overflow-t")
+    for i in range(20):
+        rec.record(ev.TASK, f"x{i}", "SUBMITTED")
+    items, dropped = rec.drain()
+    assert dropped == 16
+    global_client().send(
+        {
+            "type": "event_batch",
+            "events": items,
+            "events_dropped": dropped,
+            "source": rec.source,
+        }
+    )
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if summarize_events()["drops"].get("overflow-t", 0) >= 16:
+            break
+        time.sleep(0.2)
+    text = prometheus_text(flight_recorder_snapshot())
+    assert "# TYPE ray_tpu_flight_recorder_dropped_total counter" in text
+    assert (
+        'ray_tpu_flight_recorder_dropped_total{source="overflow-t"} 16'
+        in text
+    )
+
+
+def test_flight_recorder_overhead_budget(cluster):
+    """The recorder is always-on, so it must be nearly free: ≤5% on
+    the single_client_tasks_async shape vs recorder disabled.
+
+    Shared CI hosts swing far more than the 5% signal between fixed
+    windows, so the measurement is built to survive that: both configs
+    run in ONE cluster, A/B-ed with the runtime recording toggle in
+    tightly-paired off/on segments so drift hits both sides alike.
+    Each attempt produces two independent estimators —
+
+    - wall: each side's fastest single batch (external load only ever
+      slows a batch down, so per-side minima converge to true cost);
+    - cpu: median over pairs of the segment ratio of driver-process
+      CPU per task (`time.process_time` spans all threads of the
+      driver process, which hosts the client loop, GCS dispatch AND
+      the event indexer — exactly where recorder cost lands — and
+      neighbors' load cannot inflate it);
+
+    and the budget must fail BOTH estimators on EVERY attempt before
+    the test does. A real regression (overhead well past 5%) fails
+    them all; a one-sided load spike cannot."""
+    import statistics
+
+    from ray_tpu.util.state import set_events_recording
+
+    @ray_tpu.remote
+    def tiny():
+        return b"ok"
+
+    batch = 200
+    # Warm up: spawn workers, grow the lease pool to steady state.
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 3.0:
+        ray_tpu.get([tiny.remote() for _ in range(batch)])
+
+    def segment(rounds: int):
+        """(fastest single-batch wall seconds, CPU seconds) over
+        `rounds` batches."""
+        best_wall = float("inf")
+        c0 = time.process_time()
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            ray_tpu.get([tiny.remote() for _ in range(batch)])
+            best_wall = min(best_wall, time.perf_counter() - t0)
+        return best_wall, time.process_time() - c0
+
+    attempts = []
+    try:
+        for _attempt in range(4):
+            wall_on = wall_off = float("inf")
+            cpu_ratios = []
+            for _ in range(6):
+                set_events_recording(False)
+                w_off, c_off = segment(5)
+                set_events_recording(True)
+                w_on, c_on = segment(5)
+                wall_off = min(wall_off, w_off)
+                wall_on = min(wall_on, w_on)
+                if c_on > 0:
+                    cpu_ratios.append(c_off / c_on)
+            wall_ratio = wall_off / wall_on
+            cpu_ratio = statistics.median(cpu_ratios) if cpu_ratios else 1.0
+            attempts.append((wall_ratio, cpu_ratio))
+            if wall_ratio >= 0.95 or cpu_ratio >= 0.95:
+                break
+        else:
+            raise AssertionError(
+                "flight recorder overhead over budget on every attempt "
+                "and both estimators: (wall, cpu) off/on ratios "
+                f"{[('%.3f' % w, '%.3f' % c) for w, c in attempts]} "
+                "all < 0.95"
+            )
+    finally:
+        set_events_recording(True)  # leave the cluster fixture as found
