@@ -2,9 +2,9 @@
 # .buildkite/ + ci/ — here one deterministic make surface: native
 # build, bytecode lint, stress binaries, full suite).
 
-.PHONY: ci native lint test obs-smoke stress clean
+.PHONY: ci native lint test obs-smoke envelope-smoke stress clean
 
-ci: native lint test obs-smoke
+ci: native lint test obs-smoke envelope-smoke
 
 native:
 	$(MAKE) -C native
@@ -29,6 +29,19 @@ test:
 obs-smoke:
 	python -m pytest tests/test_observability.py \
 		tests/test_dashboard_tracing.py tests/test_logging.py -q
+
+# Object-plane envelope, scaled down (64 MiB broadcast to 4 real
+# daemon nodes, 1k args, 300 returns, 1k gets, spill-backed get) held
+# concurrently with a 20k-task/100-node scheduling stress. The full
+# reference-scale rows (1 GiB / 32 nodes / 10k / 3k / 200k-task
+# stress) run via:
+#   python -m ray_tpu._private.ray_perf --only object_envelope
+# A host that can't fit even the smoke payload records an explicit
+# object_envelope_skipped row — counted, never silent.
+envelope-smoke:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
+		--only object_envelope --envelope-smoke \
+		--out /tmp/ray_tpu_envelope_smoke.json
 
 stress:
 	$(MAKE) -C native stress-asan

@@ -141,6 +141,15 @@ class CoreClient:
         self._wait_ready: set = set()
         self._wait_interest: set = set()  # ids a wait() is blocked on
         self._wait_subscribed: set = set()  # ids subscribed at the GCS
+        # Superset of ready ∪ interest: ids wait() has classified.
+        # Registration happens ONCE per id (O(changed) across a whole
+        # drain-by-wait loop); the per-call scan pays one set probe per
+        # already-tracked ref instead of re-classifying. Pruned with
+        # the other wait sets when refs die.
+        self._wait_tracked: set = set()
+        # registered counts first-time classifications — the perf
+        # assertion in ray_perf checks it stays O(refs), not O(n^2).
+        self._wait_stats = {"registered": 0}
         self._head_conn_lost = False
 
     # --------------------------------------------------------- lazy flushing
@@ -204,6 +213,17 @@ class CoreClient:
     def _on_push(self, msg: Dict[str, Any]):
         if type(msg) is tuple and msg[0] == "RDY":
             self._wait_mark(msg[1], subscribed=True)
+            return
+        mtype = msg.get("type") if type(msg) is dict else None
+        if mtype == "borrow_update":
+            # Object plane: head-relayed borrow edges for objects this
+            # process owns — fold into the authoritative view.
+            self._tracker.apply_borrow_update(
+                msg.get("borrower", b""), msg.get("add"), msg.get("remove")
+            )
+            return
+        if mtype == "borrower_died":
+            self._tracker.sweep_borrower(msg.get("client", b""))
             return
         self._push_handler(msg)
 
@@ -276,18 +296,20 @@ class CoreClient:
         fut.add_done_callback(_done)
 
     def _wait_prune(self, oids) -> None:
-        """Refs died locally: forget their wait bookkeeping."""
+        """Refs died locally: forget their wait bookkeeping. O(changed)
+        — set difference over the dead ids only, never a rescan of the
+        live wait set."""
         cond = self._wait_cond
         with cond:
             if (
-                not self._wait_ready
-                and not self._wait_interest
+                not self._wait_tracked
                 and not self._wait_subscribed
             ):
                 return
             self._wait_ready.difference_update(oids)
             self._wait_interest.difference_update(oids)
             self._wait_subscribed.difference_update(oids)
+            self._wait_tracked.difference_update(oids)
 
     # ------------------------------------------------------------------ submit
 
@@ -407,6 +429,13 @@ class CoreClient:
             # workers drain serially either way, and mixing paths would
             # strand the GCS-routed overflow behind held leases.
             with self._lease_lock:
+                if lease["returned"]:
+                    # Reaped while the grow round-trip was in flight
+                    # (a loaded head can stall lease_worker past the
+                    # idle-return window): its conn is closing and its
+                    # reader may already be gone — a frame pushed now
+                    # would be dropped with a forever-pending future.
+                    return None  # GCS route
                 lease["outstanding"] += 1
         # t_submit truthy too: recording toggled on mid-submit must not
         # ship a half-captured span (a 0.0 boundary poisons the phase
@@ -570,6 +599,17 @@ class CoreClient:
             self._leased_conn_lost(lease, spec, oids, delivered=False)
             return refs
         self._mark_lazy(conn)
+        if conn.closed:
+            # Closed between claim and push: send_lazy only buffers, so
+            # nothing raised — and the reader that would fail our
+            # future may have died before it was registered. Resolve
+            # through the conn-lost path instead of leaving a
+            # forever-pending future (the 1-in-200k lost-task wedge);
+            # delivered=True keeps at-most-once semantics in case the
+            # frame flushed before the close landed.
+            conn.drop_future(req_id)
+            self._leased_conn_lost(lease, spec, oids, delivered=True)
+            return refs
         rfut.add_done_callback(
             lambda f, lease=lease, spec=spec, oids=oids: self._resolve_leased(
                 lease, spec, oids, f
@@ -1118,13 +1158,19 @@ class CoreClient:
         cond = self._wait_cond
         ready_set = self._wait_ready
         interest = self._wait_interest
+        tracked = self._wait_tracked
         direct = self._direct_results
         to_subscribe: List[bytes] = []
         with cond:
             for r in refs:
                 oid = r._id._bytes
-                if oid in ready_set or oid in interest:
+                if oid in tracked:
+                    # Already classified by an earlier wait() on this
+                    # id: one set probe, no re-registration — the
+                    # drain-by-wait loop registers each id exactly once.
                     continue
+                self._wait_stats["registered"] += 1
+                tracked.add(oid)
                 entry = direct.get(oid)
                 if entry is None:
                     # GCS-routed (task result, put, foreign ref):
@@ -1229,6 +1275,9 @@ class CoreClient:
             for oid in ids:
                 self._direct_results.pop(oid, None)
         self._wait_prune(ids)
+        # Explicit free: drop tracker state so the instances still alive
+        # can't emit retractions for entries already gone.
+        self._tracker.forget(ids)
         self.conn.send({"type": "free_objects", "object_ids": ids})
         # Drop our local copies (pulled replicas / remote-driver puts);
         # the GCS fan-out only reaches node daemons, not this process.

@@ -466,14 +466,15 @@ class ProxyClient:
         self.role = "driver"
         self._registered: set = set()
         self._fn_lock = threading.Lock()
-        from .ref_tracker import RefTracker, set_current
+        from .ref_tracker import LegacyRefTracker, set_current
 
-        # The stock tracker works unmodified: it sends update_refs over
-        # ``client.conn`` — here that's the session conn, and the
-        # session translates adds/removes into holds/drops of the real
-        # (proxy-owned) refs.
+        # The LEGACY (centralized) tracker on purpose: it sends
+        # update_refs over ``client.conn`` — here that's the session
+        # conn, and the session translates adds/removes into holds/
+        # drops of the real (session-owned) refs. Owner-side counting
+        # happens cluster-side in the session's own CoreClient.
         self._lineage: Dict[bytes, Any] = {}
-        self._tracker = RefTracker(self)
+        self._tracker = LegacyRefTracker(self)
         set_current(self._tracker)
 
     # ------------------------------------------------------ tracker hooks

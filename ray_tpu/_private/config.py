@@ -72,6 +72,12 @@ _DEFAULTS: Dict[str, Any] = {
     # Testing hook: read the usage fraction from this file instead of
     # /proc/meminfo.
     "testing_memory_usage_file": "",
+    # Object plane: number of head-side object-directory shards, each
+    # with its own lock domain and refcount flush queue (reference:
+    # ownership_based_object_directory.h — per-object consultation,
+    # never one global table pass). More shards = less cross-client
+    # contention; each costs one (lazily started) applier thread.
+    "object_directory_shards": 8,
     # Metrics.
     "metrics_report_interval_ms": 1000,
     # Flight recorder (reference: task_event_buffer.h +

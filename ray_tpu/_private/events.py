@@ -48,8 +48,8 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 # Categories.
-TASK, WORKER, LEASE, OBJECT, TRANSFER, SCHED = (
-    "task", "worker", "lease", "object", "transfer", "sched",
+TASK, WORKER, LEASE, OBJECT, TRANSFER, SCHED, REFS = (
+    "task", "worker", "lease", "object", "transfer", "sched", "refs",
 )
 
 #: Order of the canonical per-task transitions; also the stitch order.

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import events as _events
 from .config import RayConfig
+from .object_plane import directory as _objdir
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, WorkerID
 from .object_store import ObjectStore
 from .protocol import ConnectionLost, PeerConn
@@ -72,9 +73,18 @@ class ObjectEntry:
     # (peer, oid) one-shot wait subscriptions: pushed ("RDY", [oid]) on
     # seal (reference: raylet/wait_manager.h push-completion waits).
     subscribers: List[Tuple[PeerConn, bytes]] = field(default_factory=list)
-    # Distributed refcounting (reference: reference_count.h:61): which
-    # clients hold live ObjectRef instances; pins from in-flight task
-    # dependencies and from parent objects whose values embed this ref.
+    # Object plane (reference: reference_count.h:61 +
+    # ownership_based_object_directory.h). ``owner`` is the worker id
+    # of the client that created the object; its process keeps the
+    # authoritative instance/borrow counts and batches only the final
+    # ``release`` edge here (owner_released). ``holders`` is the
+    # head-fallback holder set: authoritative for ownerless entries
+    # (owner None — detached/stream/promoted objects), a shadow of the
+    # relayed borrow edges for owned ones (used to promote on owner
+    # death). Pins from in-flight task dependencies and from parent
+    # objects whose values embed this ref stay head-side either way.
+    owner: Optional[bytes] = None
+    owner_released: bool = False
     holders: Set[bytes] = field(default_factory=set)
     had_holder: bool = False
     task_pins: int = 0
@@ -284,7 +294,17 @@ class GcsServer:
         # gcs_placement_group_manager keeps infeasible PGs pending).
         self.autoscaling_hint = False
 
-        self.objects: Dict[bytes, ObjectEntry] = {}
+        # Sharded object directory (object_plane/directory.py): the
+        # dict facade keeps every existing call site; refcount batches
+        # enqueue to per-shard flush queues and apply OFF this process's
+        # dispatch threads. Free candidates come back through
+        # _free_candidates, which re-checks under this lock.
+        from .object_plane.directory import ShardedObjectDirectory
+
+        self.objects: ShardedObjectDirectory = ShardedObjectDirectory(
+            ObjectEntry, free_callback=self._free_candidates
+        )
+        self.objects.unpin_callback = self._release_converted_pins
         self.functions: Dict[bytes, bytes] = {}
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         self.actors: Dict[bytes, ActorState] = {}
@@ -293,9 +313,17 @@ class GcsServer:
         self._orphan_actor_tasks: Dict[bytes, List[TaskSpec]] = {}
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.nodes: Dict[bytes, NodeState] = {}
-        # Removes that raced ahead of the entry's creation (see
-        # _h_update_refs): oid -> None, FIFO-bounded.
-        self._early_drops: "OrderedDict[bytes, None]" = OrderedDict()
+        # Client id -> control conn, for borrow-edge relays to owners
+        # (object plane); maintained by _h_hello/_on_peer_close.
+        self.client_conns: Dict[bytes, PeerConn] = {}
+        # Live node-daemon control conns, upper bound (see
+        # _broadcast_free): re-registrations may double-count briefly,
+        # which only costs the slow path, never skips a real daemon.
+        self._daemon_conn_count = 0
+        # Borrower client -> owner clients it has borrowed from: lets a
+        # borrower's death notify exactly the owners that track it,
+        # without per-object holder state on the head.
+        self.borrow_edges: Dict[bytes, Set[bytes]] = {}
         # Dead nodes purge from the live table (tombstones would bloat
         # every persistence cut and scheduler/listing scan — 1k churned
         # nodes made registrations 10x slower); a bounded history ring
@@ -538,7 +566,15 @@ class GcsServer:
             self._release_lease(leased_wid)
         cid = state.get("client_id")
         if cid is not None:
+            with self._lock:
+                if self.client_conns.get(cid) is state.get("peer"):
+                    self.client_conns.pop(cid, None)
+                owners = self.borrow_edges.pop(cid, None)
             self._sweep_client_refs(cid)
+            if owners:
+                # Owners tracking this client as a borrower sweep its
+                # borrow edges (otherwise their objects never release).
+                self._notify_borrower_died(cid, owners)
         wid = state.get("worker_id")
         if wid is not None:
             self._handle_worker_death(wid, "worker connection closed")
@@ -565,6 +601,11 @@ class GcsServer:
             if "req_id" in msg:
                 peer.reply(msg, ok=False, error=f"unknown message type {mtype}")
             return
+        if _objdir.GUARD:
+            # Test instrumentation: flag this dispatch thread so the
+            # directory can assert no per-object holder mutation runs
+            # on the dispatch loop (object-plane acceptance criterion).
+            _objdir.mark_dispatch(True)
         try:
             handler(state, msg)
             if mtype in self._DURABLE_TYPES:
@@ -586,6 +627,9 @@ class GcsServer:
                     pass
             else:
                 sys.stderr.write(f"gcs: error handling {mtype}: {e}\n")
+        finally:
+            if _objdir.GUARD:
+                _objdir.mark_dispatch(False)
 
     @staticmethod
     def _maybe_inject_delay(mtype: str, spec: str):
@@ -667,6 +711,9 @@ class GcsServer:
         # its identity for refcount bookkeeping.
         state["obj_node_id"] = node_id
         state["client_id"] = msg["worker_id"]
+        with self._lock:
+            # Borrow-update relays resolve owners through this map.
+            self.client_conns[msg["worker_id"]] = peer
         peer.reply(
             msg, ok=True, session_dir=self.session_dir, node_id=node_id
         )
@@ -699,6 +746,11 @@ class GcsServer:
                 spec.function_blob = None
             for oid in spec.return_object_ids():
                 entry = self.objects.setdefault(oid.binary(), ObjectEntry())
+                if entry.owner is None:
+                    # The submitter owns the returns (reference: the
+                    # caller's core worker owns task outputs); its
+                    # process keeps the authoritative refcounts.
+                    entry.owner = state.get("client_id")
                 if entry.status in (READY, LOST):
                     # Owner resubmission after loss (lineage
                     # reconstruction): the task will reseal its returns.
@@ -862,10 +914,12 @@ class GcsServer:
 
     def _h_task_done(self, state, msg):
         freed: List[bytes] = []
+        borrow_notify: List[Tuple[bytes, bytes, bytes]] = []
         with self._lock:
-            self._apply_task_done(msg["worker_id"], msg, freed)
+            self._apply_task_done(msg["worker_id"], msg, freed, borrow_notify)
             self._work.notify_all()
         self._broadcast_free(freed)
+        self._relay_borrow_adds(borrow_notify)
         self._ingest_peer_events(msg)
 
     def _h_task_done_batch(self, state, msg):
@@ -875,11 +929,13 @@ class GcsServer:
         the aggregate cluster call rate)."""
         wid = msg["worker_id"]
         freed: List[bytes] = []
+        borrow_notify: List[Tuple[bytes, bytes, bytes]] = []
         with self._lock:
             for item in msg["items"]:
-                self._apply_task_done(wid, item, freed)
+                self._apply_task_done(wid, item, freed, borrow_notify)
             self._work.notify_all()
         self._broadcast_free(freed)
+        self._relay_borrow_adds(borrow_notify)
         self._ingest_peer_events(msg)
 
     def _ingest_peer_events(self, msg: Dict[str, Any],
@@ -915,8 +971,11 @@ class GcsServer:
         self.events.drain_local_front()
 
     def _apply_task_done(self, wid: bytes, msg: Dict[str, Any],
-                         freed: List[bytes]) -> None:
+                         freed: List[bytes],
+                         borrow_notify: Optional[List] = None) -> None:
         """Apply one completion record. Caller holds self._lock."""
+        if borrow_notify is None:
+            borrow_notify = []
         results = msg["results"]  # list of dicts per return
         error_blob = msg.get("error")
         w = self.workers.get(wid)
@@ -963,13 +1022,55 @@ class GcsServer:
             spec.max_retries -= 1
             self._pending.append(spec)
             return
+        # Borrow piggyback (reference: borrowed refs ride the task
+        # reply, reference_count.h): arg refs this worker still holds
+        # past the task's lifetime convert their dependency pins into
+        # borrow edges. The pin is NOT released here — the shard
+        # applier adds the borrow under the shard lock first, then
+        # hands the pin back through _release_converted_pins, so there
+        # is no window where a task-retained ref is neither pinned nor
+        # held.
+        borrowed: Optional[Set[bytes]] = None
+        borrow_ops: Optional[List[tuple]] = None
+        for oid in msg.get("borrows", ()):
+            if borrowed is not None and oid in borrowed:
+                continue
+            de = self.objects.get(oid)
+            if de is not None and de.owner == wid:
+                # The executing worker OWNS this dep: its tracker
+                # governs the lifetime (release on drain). A holder
+                # shadow here could never be retracted — the owner
+                # sends release, not bdel — and would pin the entry
+                # forever. Let the pin release normally below.
+                continue
+            if borrowed is None:
+                borrowed, borrow_ops = set(), []
+            if de is None:
+                # No entry (submit always pins dep entries, so this is
+                # a defensive branch): nothing to convert — land a
+                # plain holder shadow so a racing release can't free
+                # an object this worker retains (its eventual bdel
+                # clears it), and leave the pin-release loop alone.
+                borrow_ops.append(("badd", oid, wid))
+                continue
+            borrowed.add(oid)
+            borrow_ops.append(("pin2b", oid, wid))
+            if de.owner is not None:
+                borrow_notify.append((de.owner, wid, oid))
+        if borrow_ops:
+            # One enqueue for the whole record: per-oid calls would pay
+            # a shard split + wake check each inside the serialized
+            # GCS-lock region (10k-arg tasks are a supported envelope).
+            self.objects.enqueue(borrow_ops)
         for r in results:
-            entry = self.objects.setdefault(r["object_id"], ObjectEntry())
-            if r["object_id"] in self._early_drops:
-                # The owner already dropped its ref before this (batched)
+            entry, early_dropped = self.objects.seal_lookup(
+                r["object_id"], ObjectEntry()
+            )
+            if early_dropped:
+                # The owner already released before this (batched)
                 # completion created the entry: the _maybe_free below
                 # reclaims the result immediately.
-                del self._early_drops[r["object_id"]]
+                entry.owner_released = True
                 entry.had_holder = True
             if error_blob is not None:
                 entry.status = FAILED
@@ -989,13 +1090,19 @@ class GcsServer:
             self._notify_object(entry)
             # Refs already dropped before the result sealed: reclaim.
             self._maybe_free(r["object_id"], entry, freed)
-        # Task terminal: release its dependency pins.
+        # Task terminal: release its dependency pins. One pin per
+        # borrowed dep stays held — the shard applier releases it once
+        # the borrow edge has landed (see above).
         if spec is not None:
             for dep in spec.dependencies:
-                de = self.objects.get(dep.binary())
+                db = dep.binary()
+                if borrowed is not None and db in borrowed:
+                    borrowed.discard(db)
+                    continue
+                de = self.objects.get(db)
                 if de is not None:
                     de.task_pins = max(0, de.task_pins - 1)
-                    self._maybe_free(dep.binary(), de, freed)
+                    self._maybe_free(db, de, freed)
         if msg.get("actor_creation"):
             self._on_actor_created(msg["actor_id"], wid, ok=error_blob is None,
                                    error_blob=error_blob)
@@ -1061,13 +1168,13 @@ class GcsServer:
         with self._lock:
             entry = self.objects.setdefault(msg["object_id"], ObjectEntry())
             entry.status = READY
-            # Born held by the putter: the owner's batched add may be
-            # up to a flush interval behind, and a consumer's
-            # hold-and-drop remove must not find an empty holder set
-            # in that window (its later add/remove are idempotent).
+            # Born OWNED by the putter (object plane): the owner keeps
+            # the authoritative refcount in its own process and sends
+            # one release edge when it drains; no holder registration
+            # happens here or on any later instance churn.
             cid = state.get("client_id")
             if cid is not None:
-                entry.holders.add(cid)
+                entry.owner = cid
                 entry.had_holder = True
             entry.inline = msg.get("inline")
             entry.segment = msg.get("segment")
@@ -1175,6 +1282,12 @@ class GcsServer:
         entry = self.objects.pop(oid, None)
         if entry is None:
             return
+        self._dispose_entry(oid, entry, freed)
+
+    def _dispose_entry(self, oid: bytes, entry: ObjectEntry,
+                       freed: List[bytes]) -> None:
+        """Post-pop cleanup: store/spill reclaim + child-pin cascade
+        (must hold the lock)."""
         if entry.segment:
             self._store.delete(ObjectID(oid))
         if entry.spilled_path:
@@ -1190,22 +1303,31 @@ class GcsServer:
                 self._maybe_free(child, ce, freed)
 
     def _maybe_free(self, oid: bytes, entry: ObjectEntry, freed: List[bytes]) -> None:
-        """Auto-free when the last holder is gone and nothing pins the
-        entry (must hold the lock). Only entries that have had a holder
-        qualify — a fresh result whose add_ref batch hasn't landed yet
-        must not be reclaimed."""
-        if (
-            entry.had_holder
-            and not entry.holders
-            and entry.task_pins <= 0
-            and entry.child_pins <= 0
-            and entry.status != PENDING
-            and not entry.waiters
+        """Auto-free when nothing references the entry (must hold the
+        lock). Owned entries free on the owner's release edge; ownerless
+        (fallback/promoted) entries free when their holder set drains
+        having been non-empty — a fresh result whose advertisement
+        hasn't landed yet must not be reclaimed. Either way, live
+        borrower shadows, pins, waiters, and PENDING status hold it."""
+        if entry.status == PENDING or entry.waiters:
+            return
+        if entry.task_pins > 0 or entry.child_pins > 0:
+            return
+        if entry.holders:
+            return
+        if entry.owner_released or (
+            entry.owner is None and entry.had_holder
         ):
             self._free_entry(oid, freed)
 
     def _broadcast_free(self, freed: List[bytes]) -> None:
         if not freed:
+            return
+        # Upper-bound counter (bumped at daemon registration, dropped at
+        # daemon death): the common single-host case skips the lock +
+        # node scan entirely — at release-storm rates that contention
+        # was measurable against the dispatch threads.
+        if not self._daemon_conn_count:
             return
         with self._lock:
             daemons = [
@@ -1218,47 +1340,192 @@ class GcsServer:
                 pass
 
     def _h_update_refs(self, state, msg):
-        """Batched 0<->1 refcount transitions from one client
-        (reference: reference_count.h — here centralized in the
-        directory as per-object holder sets)."""
+        """Legacy centralized 0<->1 holder transitions (LegacyRefTracker
+        / head-fallback semantics). The dispatch loop only splits the
+        batch onto the shard flush queues; per-object holder mutation
+        and the early-drop ledger run on the shard appliers."""
         cid = msg["client"]
+        ops: List[tuple] = []
+        for oid in msg.get("add", ()):
+            ops.append(("add", oid, cid))
+        for oid in msg.get("remove", ()):
+            ops.append(("remove", oid, cid))
+        if ops:
+            counts = self.objects.enqueue(ops)
+            if _events.enabled():
+                _events.record(
+                    _events.REFS, cid.hex()[:12], "SHARD_ENQUEUE",
+                    {"ops": len(ops), "shards": len(counts)},
+                )
+
+    def _h_ref_flush(self, state, msg):
+        """One client's batched ownership-edge transitions (object
+        plane): owner releases, borrow edges (relayed to the owning
+        client), and head-fallback add/removes for ownerless refs.
+        NOTHING here mutates per-object state — releases and holder
+        shadows enqueue to the shard flush queues; borrow edges relay
+        as one send per owner."""
+        cid = msg["client"]
+        ops: List[tuple] = []
+        for oid in msg.get("release", ()):
+            ops.append(("release", oid, cid))
+        badd = msg.get("badd", ())
+        bdel = msg.get("bdel", ())
+        for _owner, oid in badd:
+            ops.append(("badd", oid, cid))
+        for _owner, oid in bdel:
+            ops.append(("bdel", oid, cid))
+        for oid in msg.get("add", ()):
+            ops.append(("add", oid, cid))
+        for oid in msg.get("remove", ()):
+            ops.append(("remove", oid, cid))
+        if ops:
+            counts = self.objects.enqueue(ops)
+            if _events.enabled():
+                _events.record(
+                    _events.REFS, cid.hex()[:12], "SHARD_ENQUEUE",
+                    {"ops": len(ops), "shards": len(counts)},
+                )
+        if badd or bdel:
+            groups: Dict[bytes, Tuple[List[bytes], List[bytes]]] = {}
+            for owner, oid in badd:
+                groups.setdefault(owner, ([], []))[0].append(oid)
+            for owner, oid in bdel:
+                groups.setdefault(owner, ([], []))[1].append(oid)
+            with self._lock:
+                targets = [
+                    (owner, self.client_conns.get(owner), a, r)
+                    for owner, (a, r) in groups.items()
+                ]
+                for owner, conn, a, _r in targets:
+                    if a and conn is not None:
+                        self.borrow_edges.setdefault(cid, set()).add(owner)
+            for owner, conn, a, r in targets:
+                if conn is None:
+                    # Owner gone: the entry was (or will be) promoted to
+                    # head-fallback; the shard-applied holder shadow
+                    # carries the borrow from here.
+                    continue
+                try:
+                    conn.send(
+                        {
+                            "type": "borrow_update", "borrower": cid,
+                            "add": a, "remove": r,
+                        }
+                    )
+                except ConnectionLost:
+                    pass
+
+    def _relay_borrow_adds(self, notify: List[Tuple[bytes, bytes, bytes]]):
+        """Task-done piggybacked borrows: tell each owner about its new
+        borrower (one send per owner). Called without the GCS lock."""
+        if not notify:
+            return
+        groups: Dict[Tuple[bytes, bytes], List[bytes]] = {}
+        for owner, borrower, oid in notify:
+            groups.setdefault((owner, borrower), []).append(oid)
+        with self._lock:
+            targets = [
+                (owner, borrower, self.client_conns.get(owner), oids)
+                for (owner, borrower), oids in groups.items()
+            ]
+            for owner, borrower, conn, _o in targets:
+                if conn is not None:
+                    self.borrow_edges.setdefault(borrower, set()).add(owner)
+        for owner, borrower, conn, oids in targets:
+            if conn is None:
+                continue
+            try:
+                conn.send(
+                    {
+                        "type": "borrow_update", "borrower": borrower,
+                        "add": oids, "remove": [],
+                    }
+                )
+            except ConnectionLost:
+                pass
+
+    def _notify_borrower_died(self, cid: bytes, owners) -> None:
+        """A borrowing client died without retracting: each owner sweeps
+        its borrow edges so owned objects can still release."""
+        with self._lock:
+            conns = [self.client_conns.get(o) for o in owners]
+        for conn in conns:
+            if conn is None:
+                continue
+            try:
+                conn.send({"type": "borrower_died", "client": cid})
+            except ConnectionLost:
+                pass
+
+    #: Frees per GCS-lock acquisition on the applier path: a release
+    #: flood (a driver dropping 50k refs at once) must not hold the
+    #: lock for seconds — that stalls lease_worker replies past the
+    #: client-side idle-return window and wedges lease growth.
+    _FREE_CHUNK = 512
+
+    def _free_candidates(self, oids: List[bytes]) -> None:
+        """Shard-applier callback: entries that drained. Re-check and
+        free under the GCS lock (waiters/pins/store are coherent only
+        here); the applier holds no locks when calling. Chunked so a
+        flood shares the lock with the dispatch threads."""
+        freed: List[bytes] = []
+        pop_reclaimable = self.objects.pop_reclaimable
+        for start in range(0, len(oids), self._FREE_CHUNK):
+            chunk = oids[start:start + self._FREE_CHUNK]
+            n0 = len(freed)
+            with self._lock:
+                for oid in chunk:
+                    # check+pop fused into one shard-lock acquisition:
+                    # this loop runs inside the serialized region the
+                    # dispatch hot path waits on.
+                    entry = pop_reclaimable(oid)
+                    if entry is not None:
+                        self._dispose_entry(oid, entry, freed)
+                if len(freed) > n0:
+                    # Only chunks that actually freed dirty the table.
+                    self._version += 1
+                    self._table_versions["objects"] += 1
+        self._broadcast_free(freed)
+
+    def _release_converted_pins(self, oids: List[bytes]) -> None:
+        """Shard-applier callback: pin->borrow conversions have landed;
+        hand back the dependency pins held through the conversion."""
         freed: List[bytes] = []
         with self._lock:
-            for oid in msg.get("add", []):
-                entry = self.objects.setdefault(oid, ObjectEntry())
-                entry.holders.add(cid)
-                entry.had_holder = True
-            for oid in msg.get("remove", []):
+            for oid in oids:
                 entry = self.objects.get(oid)
-                if entry is None:
-                    # Leased-path race: the owner advertises return refs
-                    # client-side only, so the directory entry is born
-                    # from the worker's BATCHED task_done — under load
-                    # that batch can land after the owner's 100ms
-                    # ref-flush already dropped the ref. Remember the
-                    # drop so the seal frees immediately instead of
-                    # leaking a result nobody holds (bounded: stale
-                    # entries age out; removes for already-freed
-                    # objects simply expire here).
-                    self._early_drops[oid] = None
-                    while len(self._early_drops) > 8192:
-                        self._early_drops.popitem(last=False)
-                    continue
-                # A removal implies the client held the ref, even if its
-                # add was compressed away within one flush window.
-                entry.had_holder = True
-                entry.holders.discard(cid)
-                self._maybe_free(oid, entry, freed)
+                if entry is not None:
+                    entry.task_pins = max(0, entry.task_pins - 1)
+                    self._maybe_free(oid, entry, freed)
+            if freed:
+                # Frees are durable objects-table state (same contract
+                # as _free_candidates).
+                self._version += 1
+                self._table_versions["objects"] += 1
         self._broadcast_free(freed)
 
     def _sweep_client_refs(self, cid: bytes) -> None:
-        """A client process is gone: drop every ref it held."""
+        """A client process is gone: drop the fallback holds it had and
+        promote the objects it OWNED to head-fallback management (the
+        holder shadow — its live borrowers — keeps them alive; an
+        unborrowed dead-owner object frees once its pins drain)."""
         freed: List[bytes] = []
+        promoted = 0
         with self._lock:
-            for oid, entry in list(self.objects.items()):
+            for oid, entry in self.objects.items():
+                if entry.owner == cid:
+                    entry.owner = None
+                    entry.had_holder = True
+                    promoted += 1
                 if cid in entry.holders:
                     entry.holders.discard(cid)
-                    self._maybe_free(oid, entry, freed)
+                self._maybe_free(oid, entry, freed)
+        if promoted and _events.enabled():
+            _events.record(
+                _events.REFS, cid.hex()[:12], "OWNER_FALLBACK",
+                {"promoted": promoted, "freed": len(freed)},
+            )
         self._broadcast_free(freed)
 
     def _h_free_objects(self, state, msg):
@@ -2003,6 +2270,7 @@ class GcsServer:
                 last_heartbeat=time.time(),
             )
             self.nodes[node.node_id.binary()] = node
+            self._daemon_conn_count += 1
             state["role"] = "raylet"
             state["node_id"] = node.node_id.binary()
             # Restored placement groups re-reserve as capacity returns.
@@ -2070,7 +2338,6 @@ class GcsServer:
         "register_function": ("functions",),
         "put_object": ("objects",),
         "free_objects": ("objects",),
-        "update_refs": ("objects",),
         "stream_item": ("objects",),
         "create_placement_group": ("placement_groups",),
         "remove_placement_group": ("placement_groups",),
@@ -2105,7 +2372,10 @@ class GcsServer:
             "kv_put", "kv_del", "register_function", "submit_task",
             "task_done", "task_done_batch", "stream_item", "put_object",
             "free_objects", "reserve_actor_name", "release_actor_name",
-            "actor_exit", "kill_actor", "update_refs",
+            "actor_exit", "kill_actor",
+            # update_refs/ref_flush apply asynchronously on the shard
+            # queues; the frees they cause bump the objects table
+            # version inside _free_candidates instead.
             "create_placement_group", "remove_placement_group",
         )
     )
@@ -2625,6 +2895,13 @@ class GcsServer:
             entry.segment = None
             self._version += 1  # spilled location is durable state
             self._table_versions["objects"] += 1
+            if _events.enabled():
+                # Spill is an ownership-edge transition: surfaced so
+                # the timeline can attribute spill-backed get stalls.
+                _events.record(
+                    _events.OBJECT, ObjectID(oid).hex(), "SPILLED",
+                    {"size": n},
+                )
         self._store.delete(ObjectID(oid))
         return n
 
@@ -2766,6 +3043,8 @@ class GcsServer:
             if node is None or not node.alive:
                 return
             node.alive = False
+            if node.conn is not None:
+                self._daemon_conn_count = max(0, self._daemon_conn_count - 1)
             node.conn = None
             # Objects whose primary copy lived on the dead node are LOST;
             # owners reconstruct them from lineage on the next get
@@ -3575,6 +3854,11 @@ class GcsServer:
     # --------------------------------------------------------------- shutdown
 
     def shutdown(self):
+        # Detach from the process-global flight-recorder ring FIRST: a
+        # late message trickling into this (dying) server's aggregator
+        # would otherwise keep its indexer draining the ring, stealing
+        # events from the next session's aggregator in this process.
+        self.events.local_recorder = None
         self._log_monitor.stop()
         if self._pub_thread is not None:
             self._pub_queue.put(None)
@@ -3620,6 +3904,7 @@ class GcsServer:
         for p in peers:
             p.close()
         self._spawner.shutdown()
+        self.objects.stop()
         for oid in segs:
             self._store.delete(oid)
         self._store.close()

@@ -1,0 +1,321 @@
+"""Owner-side reference counting: the client half of the object plane.
+
+Reference: src/ray/core_worker/reference_count.h — the process that
+creates an object (its *owner*) keeps the authoritative reference
+state: the count of local ObjectRef instances plus the set of remote
+processes borrowing the ref. The cluster directory is only told about
+ownership-edge transitions:
+
+- ``release`` — the owner's authoritative view (local count + borrows)
+  drained to zero: the object's memory can be reclaimed everywhere.
+- ``badd``/``bdel`` — a *borrowed* ref (owner is another process)
+  appeared in / vanished from this process; routed through the head to
+  the owner, which folds it into its authoritative view.
+- ``add``/``remove`` — head-fallback holder transitions for ownerless
+  refs (owner unknown: detached handles, stream items consumed through
+  a bare id); these keep the centralized semantics of the previous
+  ``ref_tracker`` for objects no owner claims.
+
+Python refcounting still does the heavy lifting: ObjectRef.__init__
+calls track(), __del__ calls untrack(); only edges cross the wire,
+batched on a flusher thread. The common case — every instance of an
+object lives in the owner process — now costs ZERO wire traffic and
+zero head-side work until the final release.
+
+Flap/suppression invariants (regression-tested):
+- a ref held and dropped (or 1->0->1 flapped) within one flush window
+  sends NOTHING for un-advertised oids;
+- a remove/bdel/release is only sent after its add (or for owner
+  returns, after submission advertised the entry), so a bare removal
+  can never race ahead of the state it retracts.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import events as _events
+
+FLUSH_INTERVAL_S = 0.1
+
+
+class OwnerRefTracker:
+    """Per-process instance tracking with owner-side authority.
+
+    API-compatible with the legacy centralized ``RefTracker``
+    (incr/decr/holds/mark_advertised/flush/stop) so the client wiring
+    and the lifetime tests drive both the same way.
+    """
+
+    def __init__(self, client):
+        # weakref: the tracker thread must not keep a closed client alive.
+        self._client = weakref.ref(client)
+        self._self_id: bytes = client.worker_id.binary()
+        self._counts: Dict[bytes, int] = {}
+        # oid -> owner worker id. b"" = ownerless (head fallback).
+        # First truthy owner wins: classification is stable per process.
+        self._owner_of: Dict[bytes, bytes] = {}
+        self._dirty: Set[bytes] = set()
+        self._lock = threading.Lock()
+        self._flusher: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._stopped = False
+        # oids whose local count hit zero; the client drops lineage for
+        # them at flush time.
+        self._zeroed: Set[bytes] = set()
+        # oids whose presence the remote side already knows about (the
+        # head for owned/ownerless oids, the owner for borrowed ones).
+        # A retraction (release/bdel/remove) is only valid after its
+        # advertisement: a ref held and dropped within one flush window
+        # must send NOTHING — a bare retraction racing ahead of the
+        # still-batched advertisement would free a live object.
+        self._advertised: Set[bytes] = set()
+        # Owned oids -> remote borrower worker ids (fed by head-relayed
+        # borrow_update pushes). A drained local count does NOT release
+        # while borrowers remain — the owner is the authority.
+        self._borrows: Dict[bytes, Set[bytes]] = {}
+        self.stats: Dict[str, int] = {
+            "flushes": 0, "releases": 0, "badd": 0, "bdel": 0,
+            "fallback_adds": 0, "fallback_removes": 0,
+        }
+
+    # ------------------------------------------------------------- tracking
+
+    def incr(self, oid: bytes, owner: bytes = b"") -> None:
+        with self._lock:
+            n = self._counts.get(oid, 0) + 1
+            self._counts[oid] = n
+            if owner and not self._owner_of.get(oid):
+                self._owner_of[oid] = owner
+            if n == 1:
+                if not self._dirty:
+                    self._wake.set()
+                self._dirty.add(oid)
+                self._zeroed.discard(oid)
+                self._ensure_flusher()
+
+    def decr(self, oid: bytes) -> None:
+        with self._lock:
+            n = self._counts.get(oid, 0) - 1
+            if n <= 0:
+                self._counts.pop(oid, None)
+                if not self._dirty:
+                    self._wake.set()
+                self._dirty.add(oid)
+                self._zeroed.add(oid)
+            else:
+                self._counts[oid] = n
+
+    def holds(self, oid: bytes) -> bool:
+        with self._lock:
+            return self._counts.get(oid, 0) > 0
+
+    def owner_of(self, oid: bytes) -> bytes:
+        with self._lock:
+            return self._owner_of.get(oid, b"")
+
+    def mark_advertised(self, oid: bytes) -> None:
+        """The remote side already records this oid's presence here:
+        the head holds the entry for owner return-refs/puts from birth,
+        or a task_done piggybacked this process's borrow. The eventual
+        drop must send its retraction."""
+        with self._lock:
+            self._advertised.add(oid)
+
+    def mark_owned(self, oid: bytes) -> None:
+        """Force owner classification (refs this process created)."""
+        with self._lock:
+            self._owner_of[oid] = self._self_id
+
+    def forget(self, oids) -> None:
+        """Explicit free(): drop all bookkeeping so the instances still
+        alive cannot emit retractions for an entry already gone."""
+        with self._lock:
+            for oid in oids:
+                self._counts.pop(oid, None)
+                self._owner_of.pop(oid, None)
+                self._advertised.discard(oid)
+                self._borrows.pop(oid, None)
+                self._dirty.discard(oid)
+                self._zeroed.discard(oid)
+
+    # ---------------------------------------------------- borrow authority
+
+    def apply_borrow_update(self, borrower: bytes, add, remove) -> None:
+        """Head-relayed borrow edges for objects this process owns."""
+        requeue = False
+        with self._lock:
+            for oid in add or ():
+                self._borrows.setdefault(oid, set()).add(borrower)
+            for oid in remove or ():
+                s = self._borrows.get(oid)
+                if s is None:
+                    continue
+                s.discard(borrower)
+                if not s:
+                    del self._borrows[oid]
+                    if (
+                        self._counts.get(oid, 0) <= 0
+                        and oid in self._advertised
+                    ):
+                        # Last borrower gone after our count drained:
+                        # the release can go out now.
+                        if not self._dirty:
+                            self._wake.set()
+                        self._dirty.add(oid)
+                        requeue = True
+        if requeue:
+            self._ensure_flusher()
+
+    def sweep_borrower(self, borrower: bytes) -> None:
+        """A borrowing process died without retracting its borrows."""
+        requeue = False
+        with self._lock:
+            for oid in list(self._borrows):
+                s = self._borrows[oid]
+                s.discard(borrower)
+                if not s:
+                    del self._borrows[oid]
+                    if (
+                        self._counts.get(oid, 0) <= 0
+                        and oid in self._advertised
+                    ):
+                        if not self._dirty:
+                            self._wake.set()
+                        self._dirty.add(oid)
+                        requeue = True
+        if requeue:
+            self._ensure_flusher()
+
+    # ------------------------------------------------------------- flushing
+
+    def _ensure_flusher(self):
+        if self._flusher is None and not self._stopped:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="ref-flusher", daemon=True
+            )
+            self._flusher.start()
+
+    def _flush_loop(self):
+        import time
+
+        # Park while clean: an idle process's tracker must cost zero
+        # wakeups. incr/decr arm the event on the empty->dirty edge;
+        # the interval sleep then batches the burst.
+        while not self._stopped:
+            self._wake.wait()
+            if self._stopped:
+                return
+            time.sleep(FLUSH_INTERVAL_S)
+            self._wake.clear()
+            client = self._client()
+            if client is None or client.conn.closed:
+                return
+            self.flush(client)
+
+    def _classify(
+        self
+    ) -> Tuple[List[bytes], List[Tuple[bytes, bytes]],
+               List[Tuple[bytes, bytes]], List[bytes], List[bytes],
+               Set[bytes]]:
+        """Net edge transitions for the dirty set. Caller holds the
+        lock. Returns (release, badd, bdel, add, remove, zeroed)."""
+        release: List[bytes] = []
+        badd: List[Tuple[bytes, bytes]] = []
+        bdel: List[Tuple[bytes, bytes]] = []
+        add: List[bytes] = []
+        remove: List[bytes] = []
+        dirty, self._dirty = self._dirty, set()
+        for oid in dirty:
+            n = self._counts.get(oid, 0)
+            owner = self._owner_of.get(oid, b"")
+            owned = owner == self._self_id
+            if n > 0:
+                # Alive. Owned oids cost nothing — the head entry's
+                # lifetime is governed solely by our eventual release.
+                if owned:
+                    continue
+                if oid in self._advertised:
+                    continue
+                self._advertised.add(oid)
+                if owner:
+                    badd.append((owner, oid))
+                else:
+                    add.append(oid)
+                continue
+            # Drained locally.
+            if owned:
+                if self._borrows.get(oid):
+                    # Remote borrowers keep the object alive; the
+                    # borrow-drain path re-dirties this oid.
+                    continue
+                if oid in self._advertised:
+                    self._advertised.discard(oid)
+                    release.append(oid)
+                # Never-advertised owned oids (flapped within one
+                # window before submission registered) send nothing.
+                self._owner_of.pop(oid, None)
+                self._borrows.pop(oid, None)
+            elif owner:
+                if oid in self._advertised:
+                    self._advertised.discard(oid)
+                    bdel.append((owner, oid))
+                self._owner_of.pop(oid, None)
+            else:
+                if oid in self._advertised:
+                    self._advertised.discard(oid)
+                    remove.append(oid)
+                self._owner_of.pop(oid, None)
+        return release, badd, bdel, add, remove, dirty
+
+    def flush(self, client) -> None:
+        """Send the net ownership-edge transitions since the last
+        flush (idempotent set semantics server-side, so transient
+        1->0->1 flaps are safe)."""
+        with self._lock:
+            if not self._dirty and not self._zeroed:
+                return
+            release, badd, bdel, add, remove, _ = self._classify()
+            zeroed, self._zeroed = self._zeroed, set()
+        if zeroed:
+            for oid in zeroed:
+                client._lineage.pop(oid, None)
+            client._wait_prune(zeroed)
+        if not (release or badd or bdel or add or remove):
+            return
+        self.stats["flushes"] += 1
+        self.stats["releases"] += len(release)
+        self.stats["badd"] += len(badd)
+        self.stats["bdel"] += len(bdel)
+        self.stats["fallback_adds"] += len(add)
+        self.stats["fallback_removes"] += len(remove)
+        if _events.enabled():
+            _events.record(
+                _events.REFS, self._self_id.hex()[:12], "REF_FLUSH",
+                {
+                    "release": len(release), "badd": len(badd),
+                    "bdel": len(bdel), "fallback": len(add) + len(remove),
+                },
+            )
+        from ..protocol import ConnectionLost
+
+        msg = {"type": "ref_flush", "client": self._self_id}
+        if release:
+            msg["release"] = release
+        if badd:
+            msg["badd"] = badd
+        if bdel:
+            msg["bdel"] = bdel
+        if add:
+            msg["add"] = add
+        if remove:
+            msg["remove"] = remove
+        try:
+            client.conn.send(msg)
+        except ConnectionLost:
+            self._stopped = True
+
+    def stop(self):
+        self._stopped = True
+        self._wake.set()

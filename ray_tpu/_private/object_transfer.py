@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from . import events as _events
 from . import transport
@@ -166,12 +166,15 @@ class ObjectFetcher:
                 return True
             _rec = _events.get_recorder()
             if not _rec.enabled:
-                return self._pull_chunks(oid, address, timeout)
+                return self._pull_chunks(oid, address, timeout)[0]
             t0 = time.time()
-            ok = self._pull_chunks(oid, address, timeout)
+            ok, size = self._pull_chunks(oid, address, timeout)
             _rec.record(
                 _events.TRANSFER, oid.hex(), "PULL",
-                {"ok": ok, "seconds": time.time() - t0, "from": address},
+                {
+                    "ok": ok, "seconds": time.time() - t0,
+                    "from": address, "bytes": size,
+                },
             )
             return ok
         finally:
@@ -179,19 +182,22 @@ class ObjectFetcher:
                 self._inflight.pop(key, None)
             ev.set()
 
-    def _pull_chunks(self, oid: ObjectID, address: str, timeout) -> bool:
+    def _pull_chunks(
+        self, oid: ObjectID, address: str, timeout
+    ) -> Tuple[bool, int]:
+        """Returns (locally readable, object size in bytes)."""
         peer = self._conn_for(address)
         first = peer.request(
             {"type": "pull_chunk", "object_id": oid.binary(), "offset": 0},
             timeout=timeout,
         )
         if not first.get("ok"):
-            return False
+            return False, 0
         size = first["size"]
         view = self._store.create_raw(oid, size)
         if view is None:
             # Local store can't hold it (exists already counts as success).
-            return self._store.contains(oid)
+            return self._store.contains(oid), size
         try:
             data = first["data"]
             view[: len(data)] = data
@@ -207,17 +213,17 @@ class ObjectFetcher:
                 )
                 if not reply.get("ok"):
                     self._store.abort_raw(oid)
-                    return False
+                    return False, size
                 chunk = reply["data"]
                 view[offset : offset + len(chunk)] = chunk
                 offset += len(chunk)
         except (ConnectionLost, TimeoutError):
             self._store.abort_raw(oid)
-            return False
+            return False, size
         finally:
             del view
         self._store.seal_raw(oid)
-        return True
+        return True, size
 
     def close(self):
         with self._lock:

@@ -188,13 +188,21 @@ class NodeDaemon:
         elif mtype == "kill_worker":
             self._kill_worker(msg["worker_id"])
         elif mtype == "free_objects":
-            for oid in msg.get("object_ids", []):
+            oids = msg.get("object_ids", [])
+            for oid in oids:
                 from .ids import ObjectID
 
                 try:
                     self.store.delete(ObjectID(oid))
                 except Exception:  # noqa: BLE001
                     pass
+            if oids and _events.enabled():
+                # Object-plane visibility: replica reclaim on this node
+                # (ships with the next heartbeat's event piggyback).
+                _events.record(
+                    _events.OBJECT, self.label or self.node_ns.rstrip("_"),
+                    "FREED_BATCH", {"n": len(oids)},
+                )
         elif mtype == "drain":
             # Graceful drain: stop granting local leases and growing the
             # pool; the head finalizes removal once we're quiet

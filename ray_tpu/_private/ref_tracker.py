@@ -2,15 +2,25 @@
 
 Reference: src/ray/core_worker/reference_count.h:61 — every process
 counts the ObjectRef instances it holds; the cluster-level view decides
-when an object's memory can be reclaimed. Centralized variant: each
-client batches its local 0<->1 transitions to the GCS, whose directory
-entry keeps a holder set per object plus pin counts for in-flight task
-dependencies and refs nested inside stored values. An entry whose
-holders drain to empty (having been non-empty) with no pins is freed
-everywhere.
+when an object's memory can be reclaimed.
+
+Two implementations share this module's track()/untrack() hooks:
+
+- :class:`~.object_plane.owner_refs.OwnerRefTracker` (the default for
+  in-cluster clients, re-exported here as ``RefTracker``): owner-side
+  counting — the process that created an object keeps the
+  authoritative holder/borrow state and batches only ownership-edge
+  transitions to the head (see object_plane/).
+
+- :class:`LegacyRefTracker`: the original centralized variant — every
+  client batches its local 0<->1 transitions as ``update_refs``
+  holder add/removes. Kept for transports whose peer interprets the
+  wire messages itself (the ray_tpu:// client proxy translates
+  adds/removes into session-held refs) and as the documented
+  head-fallback semantics for ownerless objects.
 
 Python refcounting does the heavy lifting: ObjectRef.__init__ calls
-track(), __del__ calls untrack(); only the 0<->1 edges cross the wire,
+track(), __del__ calls untrack(); only the edges cross the wire,
 batched on a flusher thread.
 """
 from __future__ import annotations
@@ -19,20 +29,26 @@ import threading
 import weakref
 from typing import Dict, Optional, Set
 
-FLUSH_INTERVAL_S = 0.1
+from .object_plane.owner_refs import (  # noqa: F401 - re-exports
+    FLUSH_INTERVAL_S,
+    OwnerRefTracker,
+)
 
-_current: Optional["RefTracker"] = None
+# The default tracker for CoreClient processes.
+RefTracker = OwnerRefTracker
+
+_current = None
 
 
-def set_current(tracker: Optional["RefTracker"]) -> None:
+def set_current(tracker) -> None:
     global _current
     _current = tracker
 
 
-def track(oid: bytes) -> None:
+def track(oid: bytes, owner: bytes = b"") -> None:
     t = _current
     if t is not None:
-        t.incr(oid)
+        t.incr(oid, owner)
 
 
 def untrack(oid: bytes) -> None:
@@ -41,7 +57,10 @@ def untrack(oid: bytes) -> None:
         t.decr(oid)
 
 
-class RefTracker:
+class LegacyRefTracker:
+    """Centralized variant: batches 0<->1 holder transitions to the
+    connected peer as ``update_refs`` messages."""
+
     def __init__(self, client):
         # weakref: the tracker thread must not keep a closed client alive.
         self._client = weakref.ref(client)
@@ -62,7 +81,7 @@ class RefTracker:
         # intermittent cross-worker arg-resolution hang).
         self._advertised: Set[bytes] = set()
 
-    def incr(self, oid: bytes) -> None:
+    def incr(self, oid: bytes, owner: bytes = b"") -> None:
         with self._lock:
             n = self._counts.get(oid, 0) + 1
             self._counts[oid] = n
@@ -95,6 +114,16 @@ class RefTracker:
         its remove."""
         with self._lock:
             self._advertised.add(oid)
+
+    def forget(self, oids) -> None:
+        """Explicitly freed oids: drop local bookkeeping (API parity
+        with OwnerRefTracker)."""
+        with self._lock:
+            for oid in oids:
+                self._counts.pop(oid, None)
+                self._advertised.discard(oid)
+                self._dirty.discard(oid)
+                self._zeroed.discard(oid)
 
     def _ensure_flusher(self):
         if self._flusher is None and not self._stopped:

@@ -91,6 +91,37 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
             trace.extend(slices)
     except Exception:  # noqa: BLE001 - recorder disabled or old head
         pass
+    try:
+        # Object-plane rows (pid "object_plane"): shard applies render
+        # as duration slices, flush/enqueue/promotion as instants — an
+        # object-plane stall shows up NEXT TO the task phase it delays
+        # (e.g. a long SHARD_APPLY beside widened seal phases).
+        refs_events = list_cluster_events(category="refs", limit=100_000)
+        for ev in refs_events:
+            attrs = ev.get("attrs") or {}
+            name = ev["event"]
+            base = {
+                "name": name,
+                "cat": "object_plane",
+                "pid": "object_plane",
+                "tid": ev["entity"],
+                "args": {**attrs, "entity": ev["entity"]},
+            }
+            if name == "SHARD_APPLY" and attrs.get("seconds") is not None:
+                dur = float(attrs["seconds"]) * 1e6
+                trace.append(
+                    {
+                        **base, "ph": "X", "dur": dur,
+                        "ts": ev["timestamp"] * 1e6 - dur,
+                    }
+                )
+            else:
+                trace.append(
+                    {**base, "ph": "i", "ts": ev["timestamp"] * 1e6,
+                     "s": "t"}
+                )
+    except Exception:  # noqa: BLE001 - recorder disabled or old head
+        pass
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -277,13 +277,16 @@ def wait(
     if isinstance(refs, ObjectRef):
         raise TypeError("wait() expects a list of ObjectRefs")
     refs = list(refs)
-    # Uniqueness on raw id bytes: hashing 28-byte keys at C speed, not
-    # ObjectRef.__hash__ chains (this runs per call in drain-by-wait
-    # loops, so the constant matters).
-    if len({r._id._bytes for r in refs}) != len(refs):
-        raise ValueError("wait() requires unique ObjectRefs")
     if num_returns > len(refs):
         raise ValueError("num_returns exceeds number of refs")
+    # Uniqueness on raw id bytes — but only where duplicates corrupt
+    # the partition count (num_returns > 1). The drain-by-wait loop
+    # (num_returns=1, called per result) must not pay an O(remaining)
+    # set build per call: that alone made the 1k-ref drain O(n^2)
+    # (the single_client_wait_1k_refs regression); with num_returns=1
+    # a duplicate is harmless (first hit wins, the rest stay pending).
+    if num_returns > 1 and len({r._id._bytes for r in refs}) != len(refs):
+        raise ValueError("wait() requires unique ObjectRefs")
     return global_client().wait(refs, num_returns=num_returns, timeout=timeout)
 
 

@@ -916,6 +916,23 @@ class WorkerRuntime:
             "results": results,
             "error": error_blob,
         }
+        if spec.dependencies:
+            # Borrow piggyback (object plane, reference: borrowed refs
+            # ride the task reply — reference_count.h): dependency refs
+            # this process still holds outlive the task's server-side
+            # pin; report them so the head converts pin -> borrow edge
+            # with no unprotected window. mark_advertised makes the
+            # eventual local drop send its bdel.
+            tracker = self.client._tracker
+            held = {
+                d.binary()
+                for d in spec.dependencies
+                if tracker.holds(d.binary())
+            }
+            if held:
+                for oid in held:
+                    tracker.mark_advertised(oid)
+                msg["borrows"] = list(held)
         if origin is not None:
             msg["direct"] = True
         if spec.actor_creation:

@@ -88,6 +88,26 @@ class DaemonCluster:
         self.authkey = _global.node.authkey
         self._daemons: List[subprocess.Popen] = []
 
+    @classmethod
+    def attach(cls) -> "DaemonCluster":
+        """Attach to the ALREADY-initialized TCP-enabled head instead of
+        starting one (``__init__`` refuses a live session). Daemons
+        added through the attached handle are owned by it — callers
+        shut them down via ``kill_node``, not ``shutdown`` (the session
+        belongs to whoever initialized it)."""
+        from ._private.worker import _global
+
+        if _global.node is None or not _global.node.tcp_address:
+            raise RuntimeError(
+                "DaemonCluster.attach needs an initialized TCP-enabled "
+                "head (init(tcp_port=...))"
+            )
+        self = cls.__new__(cls)
+        self.head_address = _global.node.tcp_address
+        self.authkey = _global.node.authkey
+        self._daemons = []
+        return self
+
     def add_node(
         self,
         *,

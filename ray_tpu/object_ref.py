@@ -42,7 +42,10 @@ class ObjectRef:
     def __init__(self, object_id: ObjectID, owner: bytes = b""):
         self._id = object_id
         self._owner = owner
-        ref_tracker.track(object_id.binary())
+        # The owner rides into the tracker: instances of objects this
+        # process owns count locally with zero wire traffic; borrowed
+        # refs report borrow edges to their owner (object_plane).
+        ref_tracker.track(object_id.binary(), owner)
 
     def __del__(self):
         try:
@@ -139,7 +142,22 @@ class ObjectRefGenerator:
         if reply.get("available"):
             oid = ObjectID(ObjectID.bytes_for_return(self._task_id, self._index))
             self._index += 1
-            return ObjectRef(oid, self._owner)
+            # Ownerless on purpose: stream items are sealed head-side by
+            # the executor (owner None in the directory) and lineage
+            # never covers streamed outputs — head-fallback holder
+            # semantics free them on drop. Owner classification would
+            # mean an owned-but-never-advertised ref whose drop sends
+            # nothing, leaking every consumed item.
+            ref = ObjectRef(oid, b"")
+            # Advertised from birth: stream_next just confirmed the
+            # head entry exists, so the eventual drop must send its
+            # remove even when the item is consumed and dropped within
+            # one flush window (otherwise fast drain loops leak every
+            # item — the entry has no other holder).
+            tracker = getattr(self._client, "_tracker", None)
+            if tracker is not None:
+                tracker.mark_advertised(oid.binary())
+            return ref
         err = reply.get("error")
         if err is not None:
             from ._private import serialization
