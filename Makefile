@@ -2,9 +2,9 @@
 # .buildkite/ + ci/ — here one deterministic make surface: native
 # build, bytecode lint, stress binaries, full suite).
 
-.PHONY: ci native lint test obs-smoke envelope-smoke stress clean
+.PHONY: ci native lint test obs-smoke envelope-smoke chaos-smoke stress clean
 
-ci: native lint test obs-smoke envelope-smoke
+ci: native lint test obs-smoke envelope-smoke chaos-smoke
 
 native:
 	$(MAKE) -C native
@@ -42,6 +42,20 @@ envelope-smoke:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
 		--only object_envelope --envelope-smoke \
 		--out /tmp/ray_tpu_envelope_smoke.json
+
+# Chaos soak, short + seeded (2 real daemon nodes, ~25s of task/actor/
+# object traffic under message drop/delay/dup/reorder on ref_flush /
+# borrow / pull paths, worker kill points, and node SIGKILLs). The run
+# prints its seed up front; any red run reproduces with
+#   python -m ray_tpu._private.ray_perf --only chaos_soak --chaos-smoke \
+#       --chaos-seed <printed seed>
+# A host without the TCP control plane records chaos_soak_skipped —
+# counted, never silent. The full multi-minute soak:
+#   python -m ray_tpu._private.ray_perf --only chaos_soak
+chaos-smoke:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
+		--only chaos_soak --chaos-smoke \
+		--out /tmp/ray_tpu_chaos_smoke.json
 
 stress:
 	$(MAKE) -C native stress-asan

@@ -176,6 +176,49 @@ def _probe_backend(timeout_s: float) -> str | None:
     return None
 
 
+def _probe_backend_with_retry(per_try_s: float, budget_s: float) -> str | None:
+    """Spend the FULL driver probe budget retrying backend init with
+    exponential backoff instead of one fixed-length probe: the r04/r05
+    wedge was environmental (relay not up yet), and a single 180 s
+    probe turned a transient into two empty scoreboard rounds
+    (ROADMAP standing item). Every attempt is tagged with a
+    flight-recorder event AND mirrored into the watchdog's partial
+    result, so a future wedge is attributable to its phase even when
+    this process is ultimately SIGKILLed."""
+    from ray_tpu._private.chaos import Backoff
+    from ray_tpu._private import events as _events
+
+    backoff = Backoff(base_s=5.0, cap_s=60.0, budget_s=budget_s)
+    deadline = time.monotonic() + budget_s
+    attempts = []
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        t0 = time.monotonic()
+        err = _probe_backend(min(per_try_s, max(10.0, remaining)))
+        took = time.monotonic() - t0
+        _events.record(
+            "bench", "backend_probe",
+            "OK" if err is None else "RETRY",
+            {"attempt": attempt, "seconds": round(took, 1),
+             "error": err or ""},
+        )
+        attempts.append(
+            {"attempt": attempt, "seconds": round(took, 1),
+             "error": err or "ok"}
+        )
+        _update_result(probe={"attempts": attempts})
+        if err is None:
+            return None
+        if time.monotonic() >= deadline or not backoff.sleep():
+            return f"{err} (after {attempt} attempts over "\
+                   f"{budget_s - max(0.0, deadline - time.monotonic()):.0f}s)"
+    return f"backend_init_budget_exhausted ({attempt} attempts)"
+
+
 
 
 def flops_per_token(n_params: float, cfg, seq_len: int) -> float:
@@ -243,16 +286,24 @@ def main() -> int:
     peak_flops = float(os.environ.get("BENCH_PEAK_FLOPS", "197e12"))  # v5e bf16
     run_moe = os.environ.get("BENCH_MOE", "1") != "0"
 
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
-    if probe_timeout > 0:
-        err = _probe_backend(probe_timeout)
-        if err is not None:
-            return _emit(err)
     # Below any plausible driver timeout: a flushed partial result beats
-    # an rc=124 with no output line.
+    # an rc=124 with no output line. Armed BEFORE the probe retries so
+    # the whole run (probe loop included) stays under one deadline.
     deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
     if deadline_s > 0:
         _start_watchdog(deadline_s)
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
+    if probe_timeout > 0:
+        # Spend the full driver budget minus what the measured bench
+        # itself needs (~300s for all phases on a healthy chip) on
+        # backend-init retries — not one fixed-length probe.
+        default_budget = max(probe_timeout, deadline_s - 300.0)
+        probe_budget = float(
+            os.environ.get("BENCH_PROBE_BUDGET_S", str(default_budget))
+        )
+        err = _probe_backend_with_retry(probe_timeout, probe_budget)
+        if err is not None:
+            return _emit(err)
 
     import jax.numpy as jnp
 

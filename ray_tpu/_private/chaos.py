@@ -1,0 +1,548 @@
+"""Deterministic chaos engine + shared resilience primitives.
+
+Reference: the reference ships a real chaos model
+(python/ray/tests/test_chaos.py — get_and_run_resource_killer over
+nodes/workers/EC2 instances) and a one-knob delay injector
+(RAY_testing_asio_delay_us, ray_config_def.h:832). This module
+generalizes both into one seed-driven :class:`FaultSchedule` woven into
+the transport boundary (protocol.PeerConn deliver), the connect path
+(transport.connect), and named process phase boundaries (kill points at
+flight-recorder event sites), plus the resilience primitives the
+runtime's retry paths share:
+
+- :class:`Backoff` / :func:`retry_call` — ONE exponential-backoff
+  implementation (full jitter, cap, optional budget) replacing the
+  scattered fixed sleeps in pulls, lease growth, and head reconnects,
+  so brief head unavailability degrades gracefully instead of
+  stampeding (reference: exponential backoff on GCS reconnect,
+  gcs_rpc_client.h).
+
+- :class:`InOrderSequencer` — per-connection sequence-number reorder
+  buffer with bounded gap skip; the GCS runs one per client conn so
+  ``ref_flush`` batches apply in submission order even when the chaos
+  engine (or a future lossy transport) duplicates, drops, or reorders
+  them.
+
+Fault spec grammar (config ``chaos_spec`` / env ``RAY_TPU_chaos_spec``,
+comma-separated entries):
+
+    <mtype>=<action>:<p>[:<a>[:<b>]][@<limit>][?role=<role>]
+        action ∈ delay (a..b microseconds) | drop | dup | reorder
+        p       firing probability per message (seeded stream)
+        @limit  fire at most <limit> times (deterministic windows)
+        ?role   only in processes of that role (driver|worker|raylet)
+
+    kill:<point>=<nth>[?role=<role>]        kill on the nth hit
+    kill:<point>=p:<prob>[?role=<role>]     probabilistic kill
+
+Determinism: every rule draws from its own ``random.Random`` seeded by
+sha256(seed, rule-text) — the nth decision of a rule is a pure function
+of (seed, rule, n), so a failed run replays with one env var
+(``RAY_TPU_chaos_seed``). Every injected fault records a CHAOS
+flight-recorder event so a red run is attributable from the timeline.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import events as _events
+
+__all__ = [
+    "Backoff",
+    "retry_call",
+    "FaultSchedule",
+    "InOrderSequencer",
+    "install",
+    "refresh",
+    "active",
+    "kill_point",
+    "mtype_of",
+]
+
+
+# ------------------------------------------------------------------ backoff
+
+
+class Backoff:
+    """Exponential backoff with full jitter and an optional budget.
+
+    The single retry-delay policy for the runtime (pulls, lease growth,
+    raylet head-reconnect, bench backend probes). Full jitter
+    (delay ~ U[0, current]) de-correlates a fleet of retriers so a head
+    blip doesn't turn into a reconnect stampede; pass a seeded ``rng``
+    for deterministic schedules in tests.
+    """
+
+    def __init__(
+        self,
+        base_s: float = 0.1,
+        cap_s: float = 5.0,
+        multiplier: float = 2.0,
+        budget_s: Optional[float] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.multiplier = multiplier
+        self.budget_s = budget_s
+        self._rng = rng or random
+        self._current = base_s
+        self._spent = 0.0
+        self.attempts = 0
+
+    def next_delay(self) -> float:
+        """The next sleep (full jitter in (0, current]); grows the
+        window toward the cap."""
+        cur = self._current
+        self._current = min(self.cap_s, cur * self.multiplier)
+        self.attempts += 1
+        # Floor at base/4 so jitter never collapses to a busy-loop.
+        d = max(self.base_s / 4.0, self._rng.uniform(0.0, cur))
+        if self.budget_s is not None:
+            d = min(d, max(0.0, self.budget_s - self._spent))
+        self._spent += d
+        return d
+
+    def exhausted(self) -> bool:
+        return self.budget_s is not None and self._spent >= self.budget_s
+
+    def sleep(self) -> bool:
+        """Sleep the next delay. False once the budget is spent."""
+        if self.exhausted():
+            return False
+        d = self.next_delay()
+        if d > 0:
+            time.sleep(d)
+        return not self.exhausted()
+
+    def reset(self) -> None:
+        self._current = self.base_s
+        self._spent = 0.0
+        self.attempts = 0
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    retry_on: Tuple[type, ...] = (OSError, TimeoutError),
+    backoff: Optional[Backoff] = None,
+    deadline_s: Optional[float] = None,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+) -> Any:
+    """Call ``fn`` until it succeeds, an unlisted exception escapes, the
+    backoff budget runs out, or ``deadline_s`` passes. The last caught
+    exception re-raises on exhaustion."""
+    bo = backoff or Backoff()
+    deadline = None if deadline_s is None else time.monotonic() + deadline_s
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 - retry loop by design
+            attempt += 1
+            if deadline is not None and time.monotonic() >= deadline:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if not bo.sleep():
+                raise
+
+
+# --------------------------------------------------------------- sequencer
+
+
+class InOrderSequencer:
+    """Reorder/dedup buffer for sequence-numbered message batches.
+
+    ``offer(seq, msg)`` returns the batches now deliverable in order.
+    ``start_seq`` fixes the expected first sequence number; senders
+    whose numbering restarts with their connection (the ref_flush
+    tracker always starts at 1 on a fresh conn) MUST pass it —
+    otherwise a dropped first batch would make its later retransmit
+    look below-baseline and be discarded as a duplicate, losing edges
+    the at-least-once protocol exists to deliver. With ``start_seq``
+    None the first seq seen is the baseline (mid-stream attach).
+    Duplicates (seq already applied) return nothing. A gap that
+    neither fills within ``gap_timeout_s`` nor stays under
+    ``max_buffered`` is SKIPPED — buffered batches flush in order and
+    the skip is counted, never silent (the pre-sequencer behavior was
+    to apply everything immediately, so a bounded skip is strictly no
+    worse)."""
+
+    def __init__(self, gap_timeout_s: float = 5.0, max_buffered: int = 64,
+                 start_seq: Optional[int] = None):
+        self.gap_timeout_s = gap_timeout_s
+        self.max_buffered = max_buffered
+        self._next: Optional[int] = start_seq
+        self._buf: Dict[int, Any] = {}
+        self._gap_since: Optional[float] = None
+        self.skipped_gaps = 0
+        self.duplicates = 0
+
+    def offer(self, seq: int, msg: Any,
+              now: Optional[float] = None) -> List[Any]:
+        now = time.monotonic() if now is None else now
+        if self._next is None:
+            self._next = seq
+        if seq < self._next:
+            self.duplicates += 1
+            return []
+        self._buf[seq] = msg
+        out: List[Any] = []
+        while self._next in self._buf:
+            out.append(self._buf.pop(self._next))
+            self._next += 1
+        if not self._buf:
+            self._gap_since = None
+            return out
+        if self._gap_since is None:
+            self._gap_since = now
+        if (
+            now - self._gap_since > self.gap_timeout_s
+            or len(self._buf) > self.max_buffered
+        ):
+            # Give up on the gap: the missing batch is lost for good
+            # (sender died un-retransmitted). Flush in order.
+            self.skipped_gaps += 1
+            for s in sorted(self._buf):
+                out.append(self._buf.pop(s))
+                self._next = s + 1
+            self._gap_since = None
+        return out
+
+
+# ------------------------------------------------------------- fault rules
+
+
+def _derive_rng(seed: int, key: str) -> random.Random:
+    # sha256, not hash(): builtin hash is salted per process and would
+    # break same-seed-same-sequence across processes/runs.
+    digest = hashlib.sha256(f"{seed}:{key}".encode()).digest()
+    return random.Random(int.from_bytes(digest[:8], "big"))
+
+
+class _MsgRule:
+    __slots__ = (
+        "mtype", "action", "p", "lo_us", "hi_us", "limit", "role",
+        "key", "rng", "fired", "hits",
+    )
+
+    def __init__(self, mtype, action, p, lo_us, hi_us, limit, role, key, rng):
+        self.mtype = mtype
+        self.action = action
+        self.p = p
+        self.lo_us = lo_us
+        self.hi_us = hi_us
+        self.limit = limit
+        self.role = role
+        self.key = key
+        self.rng = rng
+        self.fired = 0
+        self.hits = 0
+
+
+class _KillRule:
+    __slots__ = ("point", "nth", "p", "role", "key", "rng", "hits", "fired")
+
+    def __init__(self, point, nth, p, role, key, rng):
+        self.point = point
+        self.nth = nth
+        self.p = p
+        self.role = role
+        self.key = key
+        self.rng = rng
+        self.hits = 0
+        self.fired = 0
+
+
+def current_role() -> str:
+    """Coarse process role for rule scoping. Workers carry
+    RAY_TPU_WORKER_ID from spawn; raylets set RAY_TPU_CHAOS_ROLE."""
+    if os.environ.get("RAY_TPU_CHAOS_ROLE"):
+        return os.environ["RAY_TPU_CHAOS_ROLE"]
+    if os.environ.get("RAY_TPU_WORKER_ID"):
+        return "worker"
+    return "driver"
+
+
+class FaultSchedule:
+    """Seeded, rule-driven fault injection.
+
+    One instance per process (module global ``_active``); the transport
+    and phase-boundary hooks consult it. All decision state is guarded
+    by one lock — fault paths are cold by construction (p << 1), so the
+    lock never shows on a clean run's profile."""
+
+    def __init__(self, spec: str, seed: int = 0,
+                 legacy_delay_spec: str = ""):
+        self.seed = int(seed)
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._msg_rules: Dict[str, List[_MsgRule]] = {}
+        self._kill_rules: Dict[str, List[_KillRule]] = {}
+        self.stats: Dict[str, int] = {}
+        self._role = current_role()
+        for i, entry in enumerate(e for e in spec.split(",") if e.strip()):
+            self._parse_entry(entry.strip(), i)
+        if legacy_delay_spec:
+            # RAY_testing_asio_delay_us compatibility: "mtype=lo:hi"
+            # microsecond delays become always-firing delay rules.
+            for i, entry in enumerate(
+                e for e in legacy_delay_spec.split(",") if "=" in e
+            ):
+                name, rng_ = entry.split("=", 1)
+                lo, hi = rng_.split(":")
+                key = f"legacy:{entry}"
+                self._add_msg_rule(_MsgRule(
+                    name, "delay", 1.0, float(lo), float(hi), None, None,
+                    key, _derive_rng(self.seed, key),
+                ))
+
+    # ------------------------------------------------------------- parsing
+
+    def _parse_entry(self, entry: str, index: int) -> None:
+        role = None
+        if "?role=" in entry:
+            entry, role = entry.split("?role=", 1)
+        name, _, value = entry.partition("=")
+        if not value:
+            raise ValueError(f"chaos_spec entry missing '=': {entry!r}")
+        key = f"{index}:{entry}"
+        rng = _derive_rng(self.seed, key)
+        if name.startswith("kill:"):
+            point = name[len("kill:"):]
+            if value.startswith("p:"):
+                rule = _KillRule(point, None, float(value[2:]), role, key, rng)
+            else:
+                rule = _KillRule(point, int(value), None, role, key, rng)
+            self._kill_rules.setdefault(point, []).append(rule)
+            return
+        limit = None
+        if "@" in value:
+            value, lim = value.rsplit("@", 1)
+            limit = int(lim)
+        parts = value.split(":")
+        action = parts[0]
+        if action not in ("delay", "drop", "dup", "reorder"):
+            raise ValueError(f"unknown chaos action {action!r} in {entry!r}")
+        p = float(parts[1]) if len(parts) > 1 else 1.0
+        lo_us = float(parts[2]) if len(parts) > 2 else 0.0
+        hi_us = float(parts[3]) if len(parts) > 3 else lo_us
+        self._add_msg_rule(
+            _MsgRule(name, action, p, lo_us, hi_us, limit, role, key, rng)
+        )
+
+    def _add_msg_rule(self, rule: _MsgRule) -> None:
+        self._msg_rules.setdefault(rule.mtype, []).append(rule)
+
+    # ------------------------------------------------------------ decisions
+
+    def decide(self, mtype: str) -> Optional[Tuple[str, float, str]]:
+        """First firing rule's (action, delay_seconds, rule_key) for one
+        message of ``mtype``; None = deliver untouched. Each rule's
+        decision stream is deterministic under the schedule's seed."""
+        rules = self._msg_rules.get(mtype)
+        star = self._msg_rules.get("*")
+        if not rules and not star:
+            return None
+        with self._lock:
+            for rule in (rules or []) + (star or []):
+                if rule.role is not None and rule.role != self._role:
+                    continue
+                if rule.limit is not None and rule.fired >= rule.limit:
+                    continue
+                rule.hits += 1
+                if rule.p < 1.0 and rule.rng.random() >= rule.p:
+                    continue
+                rule.fired += 1
+                delay_s = 0.0
+                if rule.action == "delay":
+                    delay_s = rule.rng.uniform(rule.lo_us, rule.hi_us) / 1e6
+                k = f"{rule.action}:{mtype}"
+                self.stats[k] = self.stats.get(k, 0) + 1
+                return rule.action, delay_s, rule.key
+        return None
+
+    def intercept(self, holder: Any, mtype: str, msg: Any) -> List[Any]:
+        """Transport-boundary hook (PeerConn deliver side). Returns the
+        messages to deliver NOW, in order. ``holder`` carries the
+        reorder hold slot (``_chaos_held``) per connection."""
+        decision = self.decide(mtype)
+        held = getattr(holder, "_chaos_held", None)
+        if decision is None:
+            out = [msg]
+        else:
+            action, delay_s, rule_key = decision
+            if _events.enabled():
+                _events.record(
+                    _events.CHAOS, mtype, action.upper(),
+                    {"rule": rule_key, "delay_s": round(delay_s, 6)},
+                )
+            if action == "drop":
+                out = []
+            elif action == "dup":
+                out = [msg, msg]
+            elif action == "reorder":
+                # Hold this message; it delivers right AFTER the next
+                # one on this connection (a one-slot swap — the minimal
+                # reordering a non-FIFO transport could produce).
+                if held is None:
+                    held = holder._chaos_held = []
+                held.append(msg)
+                return []
+            else:  # delay: sleep on the reader thread — head-of-line
+                # delay, exactly what a congested link does.
+                if delay_s > 0:
+                    time.sleep(delay_s)
+                out = [msg]
+        if held:
+            out = out + held
+            del held[:]
+        return out
+
+    def drain_held(self, holder: Any) -> List[Any]:
+        """Connection closing: whatever reorder still holds delivers
+        now (a held message must never silently become a drop)."""
+        held = getattr(holder, "_chaos_held", None)
+        if not held:
+            return []
+        out, holder._chaos_held = list(held), []
+        return out
+
+    # ----------------------------------------------------------- kill points
+
+    def maybe_kill(self, point: str) -> None:
+        rules = self._kill_rules.get(point)
+        if not rules:
+            return
+        with self._lock:
+            fire = None
+            for rule in rules:
+                if rule.role is not None and rule.role != self._role:
+                    continue
+                rule.hits += 1
+                if rule.nth is not None:
+                    if rule.hits == rule.nth:
+                        fire = rule
+                        break
+                elif rule.rng.random() < (rule.p or 0.0):
+                    fire = rule
+                    break
+            if fire is None:
+                return
+            fire.fired += 1
+            self.stats[f"kill:{point}"] = (
+                self.stats.get(f"kill:{point}", 0) + 1
+            )
+        if _events.enabled():
+            _events.record(
+                _events.CHAOS, point, "KILLED", {"rule": fire.key}
+            )
+        # The ring dies with this process for workers; the stderr line
+        # ships through the log monitor so the kill stays attributable.
+        sys.stderr.write(
+            f"chaos: killing pid {os.getpid()} at {point} "
+            f"(seed={self.seed}, rule={fire.key})\n"
+        )
+        sys.stderr.flush()
+        self._kill()
+
+    def _kill(self) -> None:  # monkeypatched by tests
+        os._exit(143)
+
+    # ----------------------------------------------------------- connect hook
+
+    def on_connect(self, address: str) -> None:
+        """transport.connect chaos: 'connect' rules delay or fail
+        connection establishment (drop ⇒ OSError, the retryable
+        failure reconnect paths already handle)."""
+        decision = self.decide("connect")
+        if decision is None:
+            return
+        action, delay_s, rule_key = decision
+        if _events.enabled():
+            _events.record(
+                _events.CHAOS, "connect", action.upper(),
+                {"rule": rule_key, "address": address},
+            )
+        if action == "delay" and delay_s > 0:
+            time.sleep(delay_s)
+        elif action in ("drop", "dup", "reorder"):
+            raise OSError(f"chaos: connect to {address} refused")
+
+
+# ------------------------------------------------------------ global state
+
+#: The process-wide schedule; None = chaos off (the hot-path guard).
+_active: Optional[FaultSchedule] = None
+
+
+def install(spec: str, seed: int = 0,
+            legacy_delay_spec: str = "") -> Optional[FaultSchedule]:
+    """Explicitly (re)install the process-wide schedule. Empty spec
+    with no legacy delays deactivates."""
+    global _active
+    if not spec and not legacy_delay_spec:
+        _active = None
+    else:
+        _active = FaultSchedule(
+            spec, seed=seed, legacy_delay_spec=legacy_delay_spec
+        )
+    return _active
+
+
+def refresh() -> Optional[FaultSchedule]:
+    """(Re)build from RayConfig — called after RayConfig.initialize
+    (driver init, head bring-up) and once at import so spawned
+    processes pick the spec up from their environment."""
+    from .config import RayConfig
+
+    try:
+        spec = RayConfig.chaos_spec
+        seed = RayConfig.chaos_seed
+        legacy = RayConfig.testing_rpc_delay_us
+    except AttributeError:  # config predating these knobs
+        return _active
+    return install(spec, seed, legacy)
+
+
+def active() -> Optional[FaultSchedule]:
+    return _active
+
+
+def kill_point(name: str) -> None:
+    """Named phase-boundary kill hook (no-op unless a kill rule is
+    installed for this process — one module-global read when off)."""
+    sched = _active
+    if sched is not None:
+        sched.maybe_kill(name)
+
+
+def mtype_of(msg: Any) -> Optional[str]:
+    """Message-type key for fault rules: dict control messages use
+    their 'type'; compact tuple frames map to op_call/op_reply."""
+    t = type(msg)
+    if t is dict:
+        return msg.get("type")
+    if t is tuple and msg:
+        op = msg[0]
+        if op == 1:  # protocol.OP_CALL (literal: no import cycle)
+            return "op_call"
+        if op == 2:  # protocol.OP_REPLY
+            return "op_reply"
+        if op == "RDY":
+            return "rdy"
+    return None
+
+
+# Activate from the environment at import: worker/raylet subprocesses
+# inherit RAY_TPU_chaos_* and must not need an explicit install call.
+try:
+    refresh()
+except Exception:  # noqa: BLE001 - chaos must never break bring-up
+    _active = None

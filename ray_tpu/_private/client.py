@@ -113,7 +113,14 @@ class CoreClient:
         # Leased-worker pools per scheduling class (direct task transport).
         self._lease_lock = threading.Lock()
         self._leases: Dict[Any, list] = {}
-        self._lease_grow_failed_at: Dict[Any, float] = {}
+        # Per-class grow hold-off: a monotonic deadline computed from
+        # exponential backoff + jitter (one policy, chaos.Backoff)
+        # instead of a fixed 100ms window, so a saturated or briefly-
+        # unavailable head sees the retry rate decay instead of a
+        # stampede of synchronized grow round-trips. The deadline entry
+        # is popped (and the backoff reset) on a successful grow.
+        self._lease_grow_hold_until: Dict[Any, float] = {}
+        self._lease_backoff: Dict[Any, Any] = {}
         self._lease_reaper: Optional[threading.Thread] = None
         # Distributed refcounting + lineage (reference_count.h:61,
         # task_manager.h:269): live ObjectRef instances in this process
@@ -224,6 +231,13 @@ class CoreClient:
             return
         if mtype == "borrower_died":
             self._tracker.sweep_borrower(msg.get("client", b""))
+            return
+        if mtype == "ref_flush_ack":
+            # At-least-once ref_flush: the head received the batch;
+            # stop retransmitting it.
+            ack = getattr(self._tracker, "ack", None)
+            if ack is not None:
+                ack(msg.get("seq", 0))
             return
         self._push_handler(msg)
 
@@ -369,6 +383,9 @@ class CoreClient:
             spec.actor_id is None
             and not spec.actor_creation
             and not spec.dependencies
+            # Nested arg refs need the GCS route's lifetime pins (the
+            # leased path has no head-side pinning at all).
+            and not spec.borrowed_refs
             and spec.placement_group_id is None
             and spec.scheduling_strategy is None
             and not spec.retry_exceptions
@@ -408,21 +425,26 @@ class CoreClient:
                 # Back off after a failed grow: each attempt is a
                 # synchronous GCS round-trip, and a saturated pool would
                 # otherwise retry on every submit of a burst.
-                and now - self._lease_grow_failed_at.get(key, 0.0) > 0.1
+                and not self._lease_grow_held(key, now)
             )
             if lease is not None and not expand:
                 # Claim under the lock so the idle reaper can't return
                 # the lease between selection and push.
                 lease["outstanding"] += 1
-        if lease is None and now - self._lease_grow_failed_at.get(key, 0.0) <= 0.1:
+        if lease is None and self._lease_grow_held(key, now):
             return None  # recent failed acquire (e.g. remote driver): GCS route
         if lease is None or expand:
             fresh = self._acquire_lease(key, spec.resources)
             if fresh is not None:
                 lease = fresh
-            else:
                 with self._lease_lock:
-                    self._lease_grow_failed_at[key] = time.monotonic()
+                    # Grow succeeded: the hold-off window resets.
+                    bo = self._lease_backoff.get(key)
+                    if bo is not None:
+                        bo.reset()
+                    self._lease_grow_hold_until.pop(key, None)
+            else:
+                self._note_lease_grow_failed(key)
                 if lease is None:
                     return None  # no lease at all: GCS route
             # Pool can't grow: queue on the least-loaded lease anyway —
@@ -458,6 +480,23 @@ class CoreClient:
                 },
             )
         return self._push_leased(lease, spec)
+
+    def _lease_grow_held(self, key, now: float) -> bool:
+        """Inside the post-failure hold-off window for this class?"""
+        return now <= self._lease_grow_hold_until.get(key, 0.0)
+
+    def _note_lease_grow_failed(self, key) -> None:
+        from .chaos import Backoff
+
+        with self._lease_lock:
+            bo = self._lease_backoff.get(key)
+            if bo is None:
+                bo = self._lease_backoff[key] = Backoff(
+                    base_s=0.1, cap_s=2.0
+                )
+            self._lease_grow_hold_until[key] = (
+                time.monotonic() + max(0.05, bo.next_delay())
+            )
 
     def _raylet_conn(self) -> Optional[PeerConn]:
         """Connection to this node's raylet lease service, if any."""

@@ -53,8 +53,23 @@ _DEFAULTS: Dict[str, Any] = {
     "scheduler_top_k_fraction": 0.2,
     # Testing hook: inject a delay (us range "min:max") into control-plane
     # message handling, keyed by message type (reference:
-    # RAY_testing_asio_delay_us, ray_config_def.h:832).
+    # RAY_testing_asio_delay_us, ray_config_def.h:832). Implemented as
+    # always-firing delay rules of the chaos engine (_private/chaos.py).
     "testing_rpc_delay_us": "",
+    # Chaos engine (reference: python/ray/tests/test_chaos.py): seeded
+    # fault-injection rules applied at the transport boundary and named
+    # process kill points — see chaos.py for the spec grammar. Same
+    # seed ⇒ same injection sequence, so any red run replays with one
+    # env var.
+    "chaos_spec": "",
+    "chaos_seed": 0,
+    # How long a dead owner's promoted directory entries are held
+    # before they become reclaimable: borrow edges buffered in the
+    # borrower's unflushed ref_flush batch (or an in-flight retransmit)
+    # must be able to land on the holder shadow before the head frees
+    # the object (reference: the owner's reference table survives into
+    # the failure callback, reference_count.h).
+    "owner_death_grace_s": 2.0,
     # Object store.
     "object_store_memory_bytes": 0,  # 0 = auto-size the shm pool
     # Spill-to-disk for sealed objects under pool pressure (reference:

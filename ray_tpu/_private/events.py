@@ -48,8 +48,9 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 # Categories.
-TASK, WORKER, LEASE, OBJECT, TRANSFER, SCHED, REFS = (
+TASK, WORKER, LEASE, OBJECT, TRANSFER, SCHED, REFS, CHAOS = (
     "task", "worker", "lease", "object", "transfer", "sched", "refs",
+    "chaos",
 )
 
 #: Order of the canonical per-task transitions; also the stitch order.

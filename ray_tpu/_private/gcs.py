@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from multiprocessing.connection import Listener
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from . import chaos as _chaos
 from . import events as _events
 from .config import RayConfig
 from .object_plane import directory as _objdir
@@ -95,6 +96,12 @@ class ObjectEntry:
     # the file (or restore through the transfer plane cross-node).
     spilled_path: Optional[str] = None
     last_access: float = 0.0
+    # Owner-death grace (monotonic deadline, 0 = none): an entry
+    # promoted to head-fallback when its owner died is not reclaimable
+    # until this passes — a borrow edge buffered in the borrower's
+    # unflushed (or in-retransmit) ref_flush batch must be able to land
+    # on the holder shadow before the head frees the object.
+    promoted_hold_until: float = 0.0
 
 
 @dataclass
@@ -386,6 +393,21 @@ class GcsServer:
         # the local-dispatch tests assert intra-node chains stay off the
         # head with these).
         self.msg_counts: Dict[str, int] = {}
+        # Entries promoted on owner death, awaiting their grace expiry:
+        # (monotonic deadline, oid), appended in deadline order and
+        # drained by the health loop (re-running _maybe_free so an
+        # unborrowed promoted object still frees — just not before an
+        # in-flight borrow edge could land).
+        self._promoted_graves: deque = deque()
+        # Dead clients scheduled for a second holder sweep: the first
+        # sweep can race a shard applier already past its dead-client
+        # check; the re-sweep (one grace period later) retires anything
+        # that slipped through the crack.
+        self._dead_resweeps: deque = deque()
+        # Pick up a chaos/delay spec configured for this head (the
+        # standalone head process path never runs worker.init's
+        # refresh; redundant on the in-driver path, and cheap).
+        _chaos.refresh()
 
         head = NodeState(
             node_id=NodeID.from_random(),
@@ -592,9 +614,9 @@ class GcsServer:
     def _dispatch(self, state: Dict[str, Any], msg: Dict[str, Any]):
         mtype = msg["type"]
         self.msg_counts[mtype] = self.msg_counts.get(mtype, 0) + 1
-        delay_spec = RayConfig.testing_rpc_delay_us
-        if delay_spec:
-            self._maybe_inject_delay(mtype, delay_spec)
+        # Fault injection (including the legacy testing_rpc_delay_us
+        # delays) happens at the transport boundary now — PeerConn's
+        # deliver side runs the chaos schedule before dispatch.
         handler = getattr(self, f"_h_{mtype}", None)
         if handler is None:
             peer: PeerConn = state["peer"]
@@ -630,19 +652,6 @@ class GcsServer:
         finally:
             if _objdir.GUARD:
                 _objdir.mark_dispatch(False)
-
-    @staticmethod
-    def _maybe_inject_delay(mtype: str, spec: str):
-        # "msgtype=min:max,msgtype2=min:max" in microseconds
-        # (reference: RAY_testing_asio_delay_us, ray_config_def.h:832).
-        for entry in spec.split(","):
-            if "=" not in entry:
-                continue
-            name, rng = entry.split("=", 1)
-            if name != mtype and name != "*":
-                continue
-            lo, hi = rng.split(":")
-            time.sleep(random.uniform(float(lo), float(hi)) / 1e6)
 
     # ---------------------------------------------------------------- handlers
 
@@ -737,6 +746,10 @@ class GcsServer:
 
     def _h_submit_task(self, state, msg):
         spec: TaskSpec = msg["spec"]
+        # Submitting job identity (head-side only, never pickled): the
+        # OOM kill ladder groups victims by it so one job's burst can't
+        # starve another (worker_killing_policy_group_by_owner.h).
+        spec.owner_client = state.get("client_id")
         with self._lock:
             self._record_task_event(
                 spec.task_id.binary(), spec.name, "PENDING"
@@ -758,9 +771,14 @@ class GcsServer:
                     entry.inline = None
                     entry.segment = None
                     entry.error = None
-            # Pin dependencies for the task's lifetime so a holderless
-            # intermediate can't be reclaimed mid-flight.
+            # Pin dependencies AND nested (borrowed) arg refs for the
+            # task's lifetime so a holderless intermediate can't be
+            # reclaimed mid-flight — for nested refs this closes the
+            # window between the caller's release and the executing
+            # worker's batched badd (chaos-soak wedge).
             for dep in spec.dependencies:
+                self.objects.setdefault(dep.binary(), ObjectEntry()).task_pins += 1
+            for dep in getattr(spec, "borrowed_refs", None) or ():
                 self.objects.setdefault(dep.binary(), ObjectEntry()).task_pins += 1
             if spec.actor_id is not None and not spec.actor_creation:
                 self._route_actor_task(spec)
@@ -1090,11 +1108,14 @@ class GcsServer:
             self._notify_object(entry)
             # Refs already dropped before the result sealed: reclaim.
             self._maybe_free(r["object_id"], entry, freed)
-        # Task terminal: release its dependency pins. One pin per
-        # borrowed dep stays held — the shard applier releases it once
-        # the borrow edge has landed (see above).
+        # Task terminal: release its dependency + borrowed-ref pins.
+        # One pin per retained (borrowed) oid stays held — the shard
+        # applier releases it once the borrow edge has landed (above).
         if spec is not None:
-            for dep in spec.dependencies:
+            pinned = list(spec.dependencies) + list(
+                getattr(spec, "borrowed_refs", None) or ()
+            )
+            for dep in pinned:
                 db = dep.binary()
                 if borrowed is not None and db in borrowed:
                     borrowed.discard(db)
@@ -1233,7 +1254,20 @@ class GcsServer:
     def _h_get_object(self, state, msg):
         peer: PeerConn = state["peer"]
         with self._lock:
-            entry = self.objects.setdefault(msg["object_id"], ObjectEntry())
+            entry = self.objects.get(msg["object_id"])
+            if entry is None and self.objects.is_tombstoned(
+                msg["object_id"]
+            ):
+                # Already freed: answer LOST now — parking a waiter on
+                # a resurrected PENDING ghost would wedge this get
+                # forever (the getter reconstructs from lineage or
+                # surfaces ObjectLostError).
+                peer.reply(msg, ok=True, status=LOST)
+                return
+            if entry is None:
+                entry = self.objects.setdefault(
+                    msg["object_id"], ObjectEntry()
+                )
             if entry.status == PENDING:
                 entry.waiters.append((peer, msg["req_id"]))
                 return
@@ -1288,6 +1322,9 @@ class GcsServer:
                        freed: List[bytes]) -> None:
         """Post-pop cleanup: store/spill reclaim + child-pin cascade
         (must hold the lock)."""
+        # Tombstone: late refcount traffic / gets for this oid must
+        # fail fast, never resurrect a forever-PENDING ghost.
+        self.objects.note_tombstone(oid)
         if entry.segment:
             self._store.delete(ObjectID(oid))
         if entry.spilled_path:
@@ -1314,6 +1351,14 @@ class GcsServer:
         if entry.task_pins > 0 or entry.child_pins > 0:
             return
         if entry.holders:
+            return
+        if (
+            entry.promoted_hold_until
+            and time.monotonic() < entry.promoted_hold_until
+        ):
+            # Dead-owner grace: a borrow edge buffered in an unflushed
+            # ref_flush batch may still land. The health loop re-checks
+            # once the hold expires (_drain_promoted_graves).
             return
         if entry.owner_released or (
             entry.owner is None and entry.had_holder
@@ -1360,11 +1405,35 @@ class GcsServer:
 
     def _h_ref_flush(self, state, msg):
         """One client's batched ownership-edge transitions (object
-        plane): owner releases, borrow edges (relayed to the owning
-        client), and head-fallback add/removes for ownerless refs.
-        NOTHING here mutates per-object state — releases and holder
-        shadows enqueue to the shard flush queues; borrow edges relay
-        as one send per owner."""
+        plane). Sequenced at-least-once: the tracker numbers every
+        batch and retransmits until acked; this side acks on receipt
+        and runs a per-conn reorder/dedup buffer so batches apply in
+        submission order even when the transport (or the chaos engine)
+        drops, duplicates, or reorders them. Legacy un-numbered batches
+        (client proxy, old peers) apply directly."""
+        seq = msg.get("seq")
+        if seq is None:
+            self._apply_ref_flush(state, msg)
+            return
+        try:
+            state["peer"].send({"type": "ref_flush_ack", "seq": seq})
+        except ConnectionLost:
+            pass
+        seqr = state.get("ref_seq")
+        if seqr is None:
+            # start_seq=1: the tracker numbers from 1 per connection, so
+            # a dropped FIRST batch must read as a gap (await/accept the
+            # retransmit), never as an already-applied duplicate.
+            seqr = state["ref_seq"] = _chaos.InOrderSequencer(start_seq=1)
+        for m in seqr.offer(seq, msg):
+            self._apply_ref_flush(state, m)
+
+    def _apply_ref_flush(self, state, msg):
+        """Apply one in-order batch: owner releases, borrow edges
+        (relayed to the owning client), and head-fallback add/removes
+        for ownerless refs. NOTHING here mutates per-object state —
+        releases and holder shadows enqueue to the shard flush queues;
+        borrow edges relay as one send per owner."""
         cid = msg["client"]
         ops: List[tuple] = []
         for oid in msg.get("release", ()):
@@ -1423,6 +1492,10 @@ class GcsServer:
             return
         groups: Dict[Tuple[bytes, bytes], List[bytes]] = {}
         for owner, borrower, oid in notify:
+            if self.objects.is_dead_client(borrower):
+                # Died between task_done dispatch and this relay: a
+                # borrow add for it would never be retracted.
+                continue
             groups.setdefault((owner, borrower), []).append(oid)
         with self._lock:
             targets = [
@@ -1509,15 +1582,28 @@ class GcsServer:
         """A client process is gone: drop the fallback holds it had and
         promote the objects it OWNED to head-fallback management (the
         holder shadow — its live borrowers — keeps them alive; an
-        unborrowed dead-owner object frees once its pins drain)."""
+        unborrowed dead-owner object frees once its pins drain).
+
+        Promoted entries get a grace window before they become
+        reclaimable: a borrower's badd for this object may still sit in
+        an unflushed/in-retransmit ref_flush batch, and freeing before
+        it lands would drop a live borrow edge (the unflushed-batch
+        owner-death race). The health loop revisits them on expiry."""
         freed: List[bytes] = []
         promoted = 0
+        hold_until = time.monotonic() + RayConfig.owner_death_grace_s
+        # BEFORE touching holder sets: queued-but-unapplied holder ops
+        # for this client must not resurrect after the sweep below.
+        self.objects.note_dead_client(cid)
+        self._dead_resweeps.append((hold_until, cid))
         with self._lock:
             for oid, entry in self.objects.items():
                 if entry.owner == cid:
                     entry.owner = None
                     entry.had_holder = True
+                    entry.promoted_hold_until = hold_until
                     promoted += 1
+                    self._promoted_graves.append((hold_until, oid))
                 if cid in entry.holders:
                     entry.holders.discard(cid)
                 self._maybe_free(oid, entry, freed)
@@ -2955,16 +3041,7 @@ class GcsServer:
                 ]
                 if not victims:
                     continue
-                # Kill order: GCS-retriable first, then leased, then
-                # non-retriable; newest first within each class
-                # (reference: retriable-FIFO killing policy).
-                def _klass(w):
-                    if w.state == W_LEASED:
-                        return 1
-                    return 0 if w.current_task.max_retries > 0 else 2
-
-                victims.sort(key=lambda w: (_klass(w), -w.task_started_at))
-                victim = victims[0]
+                victim = sort_oom_victims(victims)[0]
                 name = (
                     victim.current_task.name
                     if victim.current_task is not None
@@ -3036,6 +3113,42 @@ class GcsServer:
                 self._handle_node_death(
                     nid, "node heartbeat timed out (unreachable or hung)"
                 )
+            self._drain_promoted_graves()
+
+    def _drain_promoted_graves(self) -> None:
+        """Owner-death grace expiry: re-run the free check for promoted
+        entries whose hold window passed (an unborrowed dead-owner
+        object must still free — just not before an in-flight borrow
+        edge could land on its holder shadow)."""
+        mono = time.monotonic()
+        due: List[bytes] = []
+        while self._promoted_graves and self._promoted_graves[0][0] <= mono:
+            due.append(self._promoted_graves.popleft()[1])
+        resweep: List[bytes] = []
+        while self._dead_resweeps and self._dead_resweeps[0][0] <= mono:
+            resweep.append(self._dead_resweeps.popleft()[1])
+        if not due and not resweep:
+            return
+        freed: List[bytes] = []
+        with self._lock:
+            for oid in due:
+                entry = self.objects.get(oid)
+                if entry is None:
+                    continue
+                entry.promoted_hold_until = 0.0
+                self._maybe_free(oid, entry, freed)
+            if resweep:
+                # Second pass for dead clients: retire holder shadows
+                # that raced past the first sweep on a shard applier.
+                dead = set(resweep)
+                for oid, entry in self.objects.items():
+                    if entry.holders and entry.holders & dead:
+                        entry.holders.difference_update(dead)
+                        self._maybe_free(oid, entry, freed)
+            if freed:
+                self._version += 1
+                self._table_versions["objects"] += 1
+        self._broadcast_free(freed)
 
     def _handle_node_death(self, nid: bytes, reason: str):
         with self._lock:
@@ -3224,9 +3337,12 @@ class GcsServer:
             # so parked consumers see the error instead of hanging.
             st = self._stream_state(spec.task_id.binary())
             self._end_stream(spec.task_id.binary(), st["count"], error_blob)
-        # Terminal: release dependency pins.
+        # Terminal: release dependency + borrowed-ref pins.
         freed: List[bytes] = []
-        for dep in spec.dependencies:
+        pinned = list(spec.dependencies) + list(
+            getattr(spec, "borrowed_refs", None) or ()
+        )
+        for dep in pinned:
             de = self.objects.get(dep.binary())
             if de is not None:
                 de.task_pins = max(0, de.task_pins - 1)
@@ -3908,6 +4024,47 @@ class GcsServer:
         for oid in segs:
             self._store.delete(oid)
         self._store.close()
+
+
+def sort_oom_victims(victims: List["WorkerHandle"]) -> List["WorkerHandle"]:
+    """OOM kill ladder ordering (pure; unit-tested).
+
+    Tiers (reference: worker_killing_policy_group_by_owner.h layered
+    over the retriable-FIFO policy):
+
+    1. group-by-owner fairness — prefer victims from the submitting
+       job with the MOST running tasks, so one job's burst pays for
+       the pressure it created instead of starving another job's
+       single task;
+    2. retriability — GCS-retriable first (it resubmits), then leased
+       (the caller decides retry on conn loss), then non-retriable;
+    3. newest-first within the tie (the least sunk work).
+    """
+    def _klass(w) -> int:
+        if w.state == W_LEASED:
+            return 1
+        return 0 if w.current_task.max_retries > 0 else 2
+
+    def _group(w):
+        # Owner identity is only known for GCS-routed tasks. A victim
+        # without one (leased workers: the GCS can't see their task)
+        # is its OWN singleton group — lumping all unknowns into one
+        # pseudo-job would make the fairness tier gang up on innocent
+        # leased workers from unrelated jobs.
+        t = w.current_task
+        o = getattr(t, "owner_client", None) if t is not None else None
+        return o if o else ("solo", id(w))
+
+    group_size: Dict[Any, int] = {}
+    for w in victims:
+        g = _group(w)
+        group_size[g] = group_size.get(g, 0) + 1
+    return sorted(
+        victims,
+        key=lambda w: (
+            -group_size[_group(w)], _klass(w), -w.task_started_at
+        ),
+    )
 
 
 def _reap(proc: subprocess.Popen):

@@ -46,6 +46,13 @@ from .. import events as _events
 #: Per-shard bound on remembered early drops (FIFO eviction).
 EARLY_DROP_CAP = 2048
 
+#: Per-shard bound on free tombstones (FIFO eviction). A tombstone
+#: remembers that an entry was FREED so a late borrow add (or a get)
+#: for it cannot resurrect a forever-PENDING ghost — the late holder
+#: lands on a LOST entry and the get fails fast instead of wedging
+#: (found by the chaos soak: release racing a batched badd).
+TOMBSTONE_CAP = 4096
+
 # ---------------------------------------------------------------- guard
 
 #: When True (tests), GCS dispatch threads are flagged via
@@ -93,7 +100,7 @@ class _GuardedHolderSet(set):
 class _Shard:
     __slots__ = (
         "index", "lock", "entries", "queue", "early_drops",
-        "applied", "enqueued",
+        "tombstones", "applied", "enqueued",
     )
 
     def __init__(self, index: int):
@@ -102,6 +109,7 @@ class _Shard:
         self.entries: Dict[bytes, Any] = {}
         self.queue: List[tuple] = []
         self.early_drops: "OrderedDict[bytes, None]" = OrderedDict()
+        self.tombstones: "OrderedDict[bytes, None]" = OrderedDict()
         self.applied = 0
         self.enqueued = 0
 
@@ -132,6 +140,15 @@ class ShardedObjectDirectory:
         # through here once the borrow edge has landed (set by the GCS).
         self.unpin_callback: Optional[Callable[[List[bytes]], None]] = None
         self._shards = [_Shard(i) for i in range(self.num_shards)]
+        # Clients known dead (bounded FIFO). A badd/add/pin2b op that
+        # was still sitting in a shard queue when its client's death
+        # sweep ran would otherwise apply AFTER the sweep and
+        # resurrect a holder shadow nothing ever retracts (chaos-soak
+        # leak: dead workers re-appearing in holder sets). Appliers
+        # consult this under the shard lock; mutation happens on the
+        # GCS death path.
+        self.dead_clients: "OrderedDict[bytes, None]" = OrderedDict()
+        self._dead_lock = threading.Lock()
         self._stopped = False
         # ONE applier thread services every shard queue. Shards keep
         # their own lock domains and flush queues (facade callers from
@@ -177,6 +194,7 @@ class ShardedObjectDirectory:
     def __setitem__(self, oid: bytes, entry) -> None:
         s = self._shard(oid)
         with s.lock:
+            s.tombstones.pop(oid, None)  # legitimate recreation
             s.entries[oid] = self._wrap(entry)
 
     def __contains__(self, oid: bytes) -> bool:
@@ -189,6 +207,7 @@ class ShardedObjectDirectory:
         with s.lock:
             e = s.entries.get(oid)
             if e is None:
+                s.tombstones.pop(oid, None)  # legitimate recreation
                 e = s.entries[oid] = self._wrap(default)
             return e
 
@@ -248,6 +267,7 @@ class ShardedObjectDirectory:
         with s.lock:
             e = s.entries.get(oid)
             if e is None:
+                s.tombstones.pop(oid, None)  # result (re)seal is fresh state
                 e = s.entries[oid] = self._wrap(default)
             dropped = s.early_drops.pop(oid, _MISSING) is not _MISSING
         return e, dropped
@@ -258,6 +278,39 @@ class ShardedObjectDirectory:
         s = self._shard(oid)
         with s.lock:
             return s.early_drops.pop(oid, _MISSING) is not _MISSING
+
+    # -------------------------------------------------------- tombstones
+
+    def note_tombstone(self, oid: bytes) -> None:
+        """The entry was freed: remember it (bounded) so late refcount
+        traffic and gets fail fast instead of resurrecting a ghost."""
+        s = self._shard(oid)
+        with s.lock:
+            s.tombstones[oid] = None
+            while len(s.tombstones) > TOMBSTONE_CAP:
+                s.tombstones.popitem(last=False)
+
+    def is_tombstoned(self, oid: bytes) -> bool:
+        s = self._shard(oid)
+        with s.lock:
+            return oid in s.tombstones
+
+    # ------------------------------------------------------ dead clients
+
+    DEAD_CLIENT_CAP = 1024
+
+    def note_dead_client(self, cid: bytes) -> None:
+        """Mark a client dead BEFORE sweeping its holder shadows, so
+        its queued-but-unapplied holder ops are dropped at apply time
+        instead of resurrecting after the sweep."""
+        with self._dead_lock:
+            self.dead_clients[cid] = None
+            while len(self.dead_clients) > self.DEAD_CLIENT_CAP:
+                self.dead_clients.popitem(last=False)
+
+    def is_dead_client(self, cid: bytes) -> bool:
+        with self._dead_lock:
+            return cid in self.dead_clients
 
     # ------------------------------------------------------- flush queues
 
@@ -373,6 +426,20 @@ class ShardedObjectDirectory:
         """One refcount op under the shard lock."""
         kind, oid, cid = op
         entry = s.entries.get(oid)
+        dead = cid in self.dead_clients
+        if dead and kind in ("badd", "add", "pin2b"):
+            # The client died while this op sat in the queue: adding
+            # its holder now would outlive every retraction path.
+            self.stats["dead_client_ops"] = (
+                self.stats.get("dead_client_ops", 0) + 1
+            )
+            if kind == "pin2b":
+                # The pin release half must still run or task_pins leak.
+                if unpins is not None:
+                    unpins.append(oid)
+                if entry is not None and self._reclaimable(entry):
+                    candidates.append(oid)
+            return
         if kind == "pin2b":
             # Dependency-pin -> borrow conversion (task_done piggyback):
             # record the borrow, then queue the pin release — the GCS
@@ -395,6 +462,16 @@ class ShardedObjectDirectory:
         elif kind == "badd" or kind == "add":
             if entry is None:
                 entry = s.entries[oid] = self._wrap(self._entry_factory())
+                if oid in s.tombstones:
+                    # The object was already FREED (the holder's add
+                    # lost the race to the owner's release): a PENDING
+                    # ghost here would park any get on it forever.
+                    # LOST fails those gets fast, and the entry retires
+                    # once this late holder retracts.
+                    entry.status = "LOST"
+                    self.stats["tombstone_hits"] = (
+                        self.stats.get("tombstone_hits", 0) + 1
+                    )
             entry.holders.add(cid)
             entry.had_holder = True
         elif kind == "bdel":
@@ -432,6 +509,11 @@ class ShardedObjectDirectory:
         if entry.task_pins > 0 or entry.child_pins > 0:
             return False
         if entry.holders:
+            return False
+        hold = getattr(entry, "promoted_hold_until", 0.0)
+        if hold and time.monotonic() < hold:
+            # Dead-owner grace window (see gcs._sweep_client_refs): a
+            # buffered borrow edge may still be in flight for it.
             return False
         return entry.owner_released or (
             entry.owner is None and entry.had_holder

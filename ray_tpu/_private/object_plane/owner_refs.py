@@ -32,12 +32,24 @@ Flap/suppression invariants (regression-tested):
 from __future__ import annotations
 
 import threading
+import time
 import weakref
+from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
+from .. import chaos as _chaos
 from .. import events as _events
 
 FLUSH_INTERVAL_S = 0.1
+#: Unacked ref_flush batches older than this are resent (at-least-once;
+#: the head applies edges idempotently and sequences them per conn).
+RETRANSMIT_S = 1.0
+#: Resend attempts per batch before it is counted lost (never silent).
+RETRANSMIT_MAX = 20
+#: Recently-dead borrowers remembered so a head→owner borrow relay that
+#: was delayed/reordered past the borrower_died sweep cannot re-add a
+#: borrow edge nothing will ever retract.
+DEAD_BORROWER_CAP = 256
 
 
 class OwnerRefTracker:
@@ -75,9 +87,22 @@ class OwnerRefTracker:
         # borrow_update pushes). A drained local count does NOT release
         # while borrowers remain — the owner is the authority.
         self._borrows: Dict[bytes, Set[bytes]] = {}
+        # At-least-once flush protocol: every edge-carrying ref_flush
+        # gets a per-process sequence number and is retained here until
+        # the head acks it; unacked batches retransmit on the flusher
+        # (the head's per-conn sequencer dedups and re-orders). A batch
+        # that a lossy transport eats is the correctness-critical path
+        # for owner-side counting — one lost release leaks the object
+        # cluster-wide forever.
+        self._seq = 0
+        self._unacked: "OrderedDict[int, List]" = OrderedDict()
+        # Borrowers swept by borrower_died; late borrow adds for them
+        # are stale and must be ignored (see DEAD_BORROWER_CAP).
+        self._dead_borrowers: "OrderedDict[bytes, None]" = OrderedDict()
         self.stats: Dict[str, int] = {
             "flushes": 0, "releases": 0, "badd": 0, "bdel": 0,
             "fallback_adds": 0, "fallback_removes": 0,
+            "retransmits": 0, "lost_batches": 0, "stale_borrow_adds": 0,
         }
 
     # ------------------------------------------------------------- tracking
@@ -146,6 +171,12 @@ class OwnerRefTracker:
         """Head-relayed borrow edges for objects this process owns."""
         requeue = False
         with self._lock:
+            if add and borrower in self._dead_borrowers:
+                # The relay lost a race with the borrower_died sweep
+                # (delayed/reordered delivery): adding now would pin the
+                # object on an edge nothing will ever retract.
+                self.stats["stale_borrow_adds"] += len(add)
+                add = ()
             for oid in add or ():
                 self._borrows.setdefault(oid, set()).add(borrower)
             for oid in remove or ():
@@ -172,6 +203,9 @@ class OwnerRefTracker:
         """A borrowing process died without retracting its borrows."""
         requeue = False
         with self._lock:
+            self._dead_borrowers[borrower] = None
+            while len(self._dead_borrowers) > DEAD_BORROWER_CAP:
+                self._dead_borrowers.popitem(last=False)
             for oid in list(self._borrows):
                 s = self._borrows[oid]
                 s.discard(borrower)
@@ -198,13 +232,16 @@ class OwnerRefTracker:
             self._flusher.start()
 
     def _flush_loop(self):
-        import time
-
         # Park while clean: an idle process's tracker must cost zero
         # wakeups. incr/decr arm the event on the empty->dirty edge;
-        # the interval sleep then batches the burst.
+        # the interval sleep then batches the burst. With unacked
+        # batches outstanding the park is bounded so retransmits run
+        # even when no new edges arrive.
         while not self._stopped:
-            self._wake.wait()
+            if self._unacked:
+                self._wake.wait(RETRANSMIT_S / 2)
+            else:
+                self._wake.wait()
             if self._stopped:
                 return
             time.sleep(FLUSH_INTERVAL_S)
@@ -275,9 +312,18 @@ class OwnerRefTracker:
         1->0->1 flaps are safe)."""
         with self._lock:
             if not self._dirty and not self._zeroed:
-                return
-            release, badd, bdel, add, remove, _ = self._classify()
-            zeroed, self._zeroed = self._zeroed, set()
+                pending_ack = bool(self._unacked)
+                if not pending_ack:
+                    return
+                release = badd = bdel = add = remove = ()
+                zeroed = ()
+            else:
+                release, badd, bdel, add, remove, _ = self._classify()
+                zeroed, self._zeroed = self._zeroed, set()
+        if not (release or badd or bdel or add or remove or zeroed):
+            # Nothing new this window: just service retransmits.
+            self._retransmit_due(client)
+            return
         if zeroed:
             for oid in zeroed:
                 client._lineage.pop(oid, None)
@@ -311,8 +357,57 @@ class OwnerRefTracker:
             msg["add"] = add
         if remove:
             msg["remove"] = remove
+        with self._lock:
+            self._seq += 1
+            msg["seq"] = self._seq
+            # [msg, sent_at, attempts] — retained until the head acks.
+            self._unacked[msg["seq"]] = [msg, time.monotonic(), 1]
+        # Chaos kill point: "owner killed between SEAL and REF_FLUSH" —
+        # the edges above are classified (and lineage dropped) but the
+        # batch never reaches the head.
+        _chaos.kill_point("owner.pre_ref_flush")
         try:
             client.conn.send(msg)
+        except ConnectionLost:
+            self._stopped = True
+            return
+        self._retransmit_due(client)
+
+    def ack(self, seq: int) -> None:
+        """Head acknowledged a ref_flush batch (delivered to its
+        per-conn sequencer; idempotent application from there)."""
+        with self._lock:
+            self._unacked.pop(seq, None)
+
+    def _retransmit_due(self, client) -> None:
+        """Resend unacked batches past the retransmit age; bounded
+        attempts, lost batches counted — never silent."""
+        now = time.monotonic()
+        resend: List[dict] = []
+        with self._lock:
+            for seq, rec in list(self._unacked.items()):
+                if now - rec[1] < RETRANSMIT_S:
+                    break  # OrderedDict: the rest are younger
+                if rec[2] >= RETRANSMIT_MAX:
+                    del self._unacked[seq]
+                    self.stats["lost_batches"] += 1
+                    continue
+                rec[1] = now
+                rec[2] += 1
+                resend.append(rec[0])
+        if not resend:
+            return
+        from ..protocol import ConnectionLost
+
+        self.stats["retransmits"] += len(resend)
+        if _events.enabled():
+            _events.record(
+                _events.REFS, self._self_id.hex()[:12], "REF_REFLUSH",
+                {"batches": len(resend)},
+            )
+        try:
+            for m in resend:
+                client.conn.send(m)
         except ConnectionLost:
             self._stopped = True
 

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from . import chaos as _chaos
 from . import events as _events
 from . import transport
 from .ids import ObjectID
@@ -22,6 +23,10 @@ from .object_store import ObjectStore
 from .protocol import ConnectionLost, PeerConn
 
 CHUNK_BYTES = 4 << 20  # reference: object_manager_default_chunk_size (5 MiB)
+#: Per-attempt ceiling on one chunk request: a dropped request surfaces
+#: as a timeout this fast and the pull retries with backoff instead of
+#: burning the whole pull deadline waiting on one lost frame.
+ATTEMPT_TIMEOUT_S = 10.0
 
 
 class ObjectTransferServer:
@@ -146,8 +151,21 @@ class ObjectFetcher:
             self._conns[address] = peer
         return peer
 
+    def _drop_conn(self, address: str) -> None:
+        """Forget a cached transfer conn (failed attempt: reconnect)."""
+        with self._lock:
+            peer = self._conns.pop(address, None)
+        if peer is not None:
+            peer.close()
+
     def pull(self, oid: ObjectID, address: str, timeout: Optional[float] = 60.0) -> bool:
         """Fetch the object from `address` into the local store.
+
+        Transient failures (lost/timed-out chunk request, dropped conn)
+        retry with exponential backoff + jitter until ``timeout``
+        (reference: PullManager retries pulls on a timer,
+        pull_manager.h); a definitive "object not found" fails fast so
+        directory re-lookup/reconstruction can run instead.
 
         Returns True when the object is locally readable afterwards."""
         key = oid.binary()
@@ -165,17 +183,45 @@ class ObjectFetcher:
             if self._store.contains(oid):
                 return True
             _rec = _events.get_recorder()
-            if not _rec.enabled:
-                return self._pull_chunks(oid, address, timeout)[0]
             t0 = time.time()
-            ok, size = self._pull_chunks(oid, address, timeout)
-            _rec.record(
-                _events.TRANSFER, oid.hex(), "PULL",
-                {
-                    "ok": ok, "seconds": time.time() - t0,
-                    "from": address, "bytes": size,
-                },
-            )
+            deadline = time.monotonic() + (timeout or 60.0)
+            backoff = _chaos.Backoff(base_s=0.05, cap_s=2.0)
+            ok, size, attempts = False, 0, 0
+            while True:
+                attempts += 1
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    ok, size, transient = self._pull_chunks(
+                        oid, address, min(remaining, ATTEMPT_TIMEOUT_S)
+                    )
+                except (ConnectionLost, OSError):
+                    ok, size, transient = False, 0, True
+                if ok or not transient:
+                    break
+                # Reconnect next attempt: the conn may be the casualty.
+                self._drop_conn(address)
+                if _rec.enabled:
+                    _rec.record(
+                        _events.TRANSFER, oid.hex(), "PULL_RETRY",
+                        {"attempt": attempts, "from": address},
+                    )
+                delay = min(
+                    backoff.next_delay(),
+                    max(0.0, deadline - time.monotonic()),
+                )
+                if delay > 0:
+                    time.sleep(delay)
+            if _rec.enabled:
+                _rec.record(
+                    _events.TRANSFER, oid.hex(), "PULL",
+                    {
+                        "ok": ok, "seconds": time.time() - t0,
+                        "from": address, "bytes": size,
+                        "attempts": attempts,
+                    },
+                )
             return ok
         finally:
             with self._lock:
@@ -184,25 +230,36 @@ class ObjectFetcher:
 
     def _pull_chunks(
         self, oid: ObjectID, address: str, timeout
-    ) -> Tuple[bool, int]:
-        """Returns (locally readable, object size in bytes)."""
+    ) -> Tuple[bool, int, bool]:
+        """One pull attempt. Returns (locally readable, size,
+        transient) — transient=True means a retry may succeed (timeout,
+        lost conn); False is definitive (object not found)."""
+        import concurrent.futures
+
         peer = self._conn_for(address)
-        first = peer.request(
-            {"type": "pull_chunk", "object_id": oid.binary(), "offset": 0},
-            timeout=timeout,
-        )
+        try:
+            first = peer.request(
+                {"type": "pull_chunk", "object_id": oid.binary(), "offset": 0},
+                timeout=timeout,
+            )
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            return False, 0, True
         if not first.get("ok"):
-            return False, 0
+            return False, 0, False
         size = first["size"]
         view = self._store.create_raw(oid, size)
         if view is None:
             # Local store can't hold it (exists already counts as success).
-            return self._store.contains(oid), size
+            return self._store.contains(oid), size, False
         try:
             data = first["data"]
             view[: len(data)] = data
             offset = len(data)
             while offset < size:
+                # Chaos: "kill node mid-pull" — a consumer dying with a
+                # half-written unsealed replica (the abort path must
+                # reclaim it, and the producer side must shrug).
+                _chaos.kill_point("transfer.mid_pull")
                 reply = peer.request(
                     {
                         "type": "pull_chunk",
@@ -213,17 +270,18 @@ class ObjectFetcher:
                 )
                 if not reply.get("ok"):
                     self._store.abort_raw(oid)
-                    return False, size
+                    return False, size, False
                 chunk = reply["data"]
                 view[offset : offset + len(chunk)] = chunk
                 offset += len(chunk)
-        except (ConnectionLost, TimeoutError):
+        except (ConnectionLost, TimeoutError,
+                concurrent.futures.TimeoutError):
             self._store.abort_raw(oid)
-            return False, size
+            return False, size, True
         finally:
             del view
         self._store.seal_raw(oid)
-        return True, size
+        return True, size, False
 
     def close(self):
         with self._lock:

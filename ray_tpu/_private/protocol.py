@@ -31,6 +31,7 @@ from concurrent.futures import Future
 from multiprocessing.connection import Connection
 from typing import Any, Callable, Dict, List, Optional
 
+from . import chaos as _chaos
 from . import fastpath as _fastpath
 
 # Tuple-frame opcodes.
@@ -195,6 +196,27 @@ class PeerConn:
     # ---------------------------------------------------------------- receive
 
     def _deliver(self, msg: Any) -> None:
+        if type(msg) is tuple and msg[0] == "B":
+            # Coalesced envelope: chaos (and delivery) act per inner
+            # message, never on the envelope itself.
+            for m in msg[1]:
+                self._deliver(m)
+            return
+        sched = _chaos._active
+        if sched is None:
+            self._deliver_one(msg)
+            return
+        # Chaos engine: the transport boundary — one message in may
+        # deliver zero (drop/held), one, or several (dup/released
+        # reorder hold) messages, in the schedule's order.
+        mtype = _chaos.mtype_of(msg)
+        if mtype is None:
+            self._deliver_one(msg)
+            return
+        for m in sched.intercept(self, mtype, msg):
+            self._deliver_one(m)
+
+    def _deliver_one(self, msg: Any) -> None:
         if type(msg) is tuple:
             op = msg[0]
             if op == OP_REPLY:
@@ -202,9 +224,6 @@ class PeerConn:
                     fut = self._pending.pop(msg[1], None)
                 if fut is not None and not fut.done():
                     fut.set_result(msg)
-            elif op == "B":
-                for m in msg[1]:
-                    self._deliver(m)
             else:
                 self._push_handler(msg)
         elif msg.get("type") == "reply":
@@ -253,6 +272,15 @@ class PeerConn:
             if not (sys.is_finalizing() or self._conn.closed):
                 raise
         finally:
+            sched = _chaos._active
+            if sched is not None:
+                # A reorder hold must never silently become a drop:
+                # deliver anything still held before close bookkeeping.
+                for m in sched.drain_held(self):
+                    try:
+                        self._deliver_one(m)
+                    except Exception:  # noqa: BLE001
+                        pass
             self._closed.set()
             with self._pending_lock:
                 pending = list(self._pending.values())

@@ -417,6 +417,32 @@ ENVELOPE_SMOKE = {
     "stress_nodes": 100,
 }
 
+#: Chaos-soak fault schedule: message faults on exactly the paths the
+#: object plane's correctness rides (ref_flush batches, head→owner
+#: borrow relays, pull chunk streams) plus low-probability process
+#: kills at the owner/worker phase boundaries. Deterministic under the
+#: run's seed — a red run replays with the printed --chaos-seed.
+CHAOS_SPEC = (
+    "ref_flush=drop:0.05,"
+    "ref_flush=dup:0.05,"
+    "ref_flush=delay:0.10:2000:20000,"
+    "borrow_update=reorder:0.10,"
+    "pull_chunk=drop:0.03,"
+    "pull_chunk=delay:0.10:1000:10000,"
+    "kill:owner.pre_ref_flush=p:0.002?role=worker,"
+    "kill:worker.pre_task_done=p:0.002?role=worker"
+)
+CHAOS_FULL = {
+    "seconds": 180, "nodes": 4, "seed": 0xC7A05, "kill_every_s": 15.0,
+    "payload_bytes": 256 << 10, "get_timeout_s": 120.0,
+    "spec": CHAOS_SPEC,
+}
+CHAOS_SMOKE = {
+    "seconds": 25, "nodes": 2, "seed": 0xC7A05, "kill_every_s": 9.0,
+    "payload_bytes": 128 << 10, "get_timeout_s": 90.0,
+    "spec": CHAOS_SPEC,
+}
+
 
 @ray_tpu.remote(num_cpus=1)
 def _envelope_fetch(x):
@@ -711,6 +737,295 @@ def bench_object_envelope(cfg: Dict[str, int]):
             cluster.kill_node(proc)
 
 
+@ray_tpu.remote(num_cpus=1, max_retries=5)
+def _chaos_chew(x):
+    """Soak traffic unit: materialize the arg (possibly a cross-node
+    pull under fault injection) and seal a derived result."""
+    import numpy as _np
+
+    a = _np.asarray(x, dtype=_np.float64).ravel()
+    return (a[: 8 * 1024] + 1.0).copy()
+
+
+@ray_tpu.remote(max_restarts=100)
+class _ChaosKeeper:
+    """Borrower actor for the soak: retains refs across its own chaos
+    restarts so borrower_died sweeps race live borrow traffic."""
+
+    def __init__(self):
+        self.refs = []
+
+    def keep(self, refs):
+        self.refs = refs
+        return len(refs)
+
+    def read(self):
+        if not self.refs:
+            return 0.0
+        return float(sum(ray_tpu.get(r)[0] for r in self.refs))
+
+    def die(self):
+        import os as _os
+
+        _os._exit(1)
+
+
+def bench_chaos_soak(cfg: Dict[str, float]):
+    """Seeded chaos soak (acceptance: ISSUE 8): a DaemonCluster runs
+    task/actor/object traffic while the fault schedule drops, delays,
+    duplicates and reorders ref_flush / borrow / pull messages, kills
+    workers at phase boundaries, and a kill-loop SIGKILLs node daemons —
+    asserting (a) traffic keeps completing with zero wedged ray.get
+    futures, (b) no leaked directory entries or store bytes once the
+    refs drop, and (c) every injected fault is visible as a CHAOS
+    flight-recorder event. Deterministic per seed; a failure prints the
+    seed for one-flag reproduction."""
+    import gc
+    import os
+    import random
+    import threading
+
+    from ray_tpu.cluster_utils import DaemonCluster
+    from ray_tpu._private import chaos as _chaos
+    from ray_tpu._private import events as _events
+    from ray_tpu._private.config import RayConfig
+    from ray_tpu._private.state import list_cluster_events
+    from ray_tpu._private.worker import _global, global_client
+    from ray_tpu.exceptions import GetTimeoutError
+
+    seed = int(cfg["seed"])
+    spec = str(cfg["spec"])
+    seconds = float(cfg["seconds"])
+    print(f"chaos_soak: seed={seed} (reproduce with --chaos-seed {seed})")
+    print(f"chaos_soak: spec={spec}")
+    try:
+        cluster = DaemonCluster.attach()
+    except RuntimeError:
+        RESULTS["chaos_soak_skipped"] = 1.0
+        print("chaos_soak: SKIPPED — head has no TCP control plane")
+        return
+
+    # Activate the schedule here AND in the environment so every daemon
+    # and worker spawned during the soak inherits it.
+    os.environ["RAY_TPU_chaos_spec"] = spec
+    os.environ["RAY_TPU_chaos_seed"] = str(seed)
+    RayConfig._values["chaos_spec"] = spec
+    RayConfig._values["chaos_seed"] = seed
+    _chaos.install(spec, seed, RayConfig.testing_rpc_delay_us)
+
+    gcs = _global.node.gcs
+    pool = getattr(gcs._store, "_pool", None)
+    rng = random.Random(seed)
+    n_nodes = int(cfg["nodes"])
+    soak_daemons = []
+    for i in range(n_nodes):
+        soak_daemons.append(
+            cluster.add_node(
+                num_cpus=2, resources={"chaos": 100.0},
+                label=f"chaos{i}",
+            )
+        )
+    # Warm one worker per node and settle, then take the leak baseline.
+    chew = _chaos_chew.options(resources={"chaos": 0.001})
+    ray_tpu.get([chew.remote([float(i)]) for i in range(n_nodes)],
+                timeout=300)
+    gc.collect()
+    global_client()._tracker.flush(global_client())
+    time.sleep(1.0)
+    baseline_entries = len(gcs.objects)
+    baseline_oids = set(gcs.objects.keys())
+    baseline_bytes = (
+        pool.stats().get("bytes_in_use", 0) if pool is not None else 0
+    )
+
+    stop = threading.Event()
+    stats = {"ok": 0, "failed": 0, "keeper_ok": 0, "node_kills": 0}
+    wedged: List[str] = []
+    get_timeout = float(cfg["get_timeout_s"])
+    payload_n = max(1024, int(cfg["payload_bytes"]) // 8)
+
+    def traffic(idx: int):
+        lrng = random.Random(seed ^ (idx + 1))
+        base = np.ones(payload_n)
+        while not stop.is_set():
+            try:
+                ref = ray_tpu.put(base * lrng.random())
+                r1 = chew.remote(ref)
+                r2 = chew.remote(r1)  # consumes a worker-sealed result
+                out = ray_tpu.get(r2, timeout=get_timeout)
+                assert len(out) > 0
+                stats["ok"] += 1
+                del ref, r1, r2, out
+            except GetTimeoutError as e:
+                wedged.append(f"traffic[{idx}]: {e}")
+                return
+            except Exception:  # noqa: BLE001 - kills make failures legal
+                stats["failed"] += 1
+                time.sleep(0.1)
+
+    def keeper_loop():
+        k = _ChaosKeeper.remote()
+        n = 0
+        while not stop.is_set():
+            try:
+                refs = [ray_tpu.put(np.arange(4096.0)) for _ in range(4)]
+                ray_tpu.get(k.keep.remote(refs), timeout=get_timeout)
+                del refs
+                ray_tpu.get(k.read.remote(), timeout=get_timeout)
+                stats["keeper_ok"] += 1
+                n += 1
+                if n % 7 == 0:
+                    # Actor restart racing the borrower_died sweep.
+                    k.die.remote()
+                    time.sleep(0.5)
+            except GetTimeoutError as e:
+                wedged.append(f"keeper: {e}")
+                return
+            except Exception:  # noqa: BLE001
+                stats["failed"] += 1
+                time.sleep(0.2)
+        try:
+            ray_tpu.kill(k)
+        except Exception:  # noqa: BLE001
+            pass
+
+    threads = [
+        threading.Thread(target=traffic, args=(i,), daemon=True)
+        for i in range(2)
+    ] + [threading.Thread(target=keeper_loop, daemon=True)]
+    t0 = time.perf_counter()
+    try:
+        for t in threads:
+            t.start()
+        # Kill loop: SIGKILL a random soak daemon on a seeded cadence,
+        # then grow a replacement — membership churn under load.
+        next_kill = time.monotonic() + float(cfg["kill_every_s"])
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline and not wedged:
+            time.sleep(0.25)
+            if time.monotonic() < next_kill:
+                continue
+            next_kill = time.monotonic() + float(cfg["kill_every_s"])
+            live = [p for p in soak_daemons if p.poll() is None]
+            if len(live) < 2:
+                continue
+            victim = live[rng.randrange(len(live))]
+            _events.record(
+                _events.CHAOS, f"pid-{victim.pid}", "NODE_KILL",
+                {"seed": seed},
+            )
+            cluster.kill_node(victim)
+            soak_daemons.remove(victim)
+            stats["node_kills"] += 1
+            replacement = cluster.add_node(
+                num_cpus=2, resources={"chaos": 100.0},
+                label=f"chaos-r{stats['node_kills']}", wait=False,
+            )
+            soak_daemons.append(replacement)
+        stop.set()
+        for t in threads:
+            # A traffic thread that cannot finish its in-flight op is a
+            # wedged future — exactly what the soak exists to catch.
+            t.join(timeout=get_timeout + 60)
+            if t.is_alive():
+                wedged.append(f"{t.name} did not finish after stop")
+        soak_s = time.perf_counter() - t0
+
+        # ------------------------------------------------ leak assertions
+        gc.collect()
+        global_client()._tracker.flush(global_client())
+        leak_deadline = time.monotonic() + 90
+        leaked = len(gcs.objects) - baseline_entries
+        while time.monotonic() < leak_deadline:
+            gc.collect()
+            global_client()._tracker.flush(global_client())
+            gcs.objects.flush(timeout=5)
+            leaked = len(gcs.objects) - baseline_entries
+            if leaked <= 16:
+                break
+            time.sleep(1.0)
+        if leaked > 0:
+            # Attribution: what state is pinning the residue? (A held
+            # entry here is a soak failure in the making — name it.)
+            for oid, e in gcs.objects.items():
+                if oid in baseline_oids:
+                    continue
+                print(
+                    f"chaos_soak: residual entry {oid.hex()[:12]} "
+                    f"status={e.status} owner="
+                    f"{e.owner.hex()[:8] if e.owner else None} "
+                    f"released={e.owner_released} "
+                    f"holders={[h.hex()[:8] for h in e.holders]} "
+                    f"pins={e.task_pins}"
+                    f"+{e.child_pins} waiters={len(e.waiters)}"
+                )
+            if leaked > 16:
+                with gcs._lock:
+                    for wid, w in gcs.workers.items():
+                        print(
+                            f"chaos_soak: worker {wid.hex()[:8]} "
+                            f"state={w.state} conn_alive="
+                            f"{w.conn is not None and not w.conn.closed}"
+                        )
+        leaked_bytes = 0
+        if pool is not None:
+            leaked_bytes = max(
+                0, pool.stats().get("bytes_in_use", 0) - baseline_bytes
+            )
+        faults = list_cluster_events(category="chaos", limit=100_000)
+        fault_kinds = {e["event"] for e in faults}
+
+        RESULTS["chaos_soak_seconds"] = round(soak_s, 1)
+        RESULTS["chaos_soak_ops_ok"] = stats["ok"] + stats["keeper_ok"]
+        RESULTS["chaos_soak_ops_failed"] = stats["failed"]
+        RESULTS["chaos_soak_node_kills"] = stats["node_kills"]
+        RESULTS["chaos_soak_faults_injected"] = len(faults)
+        RESULTS["chaos_soak_leaked_entries"] = max(0, leaked)
+        print(
+            f"chaos_soak: {soak_s:.0f}s, ops ok={stats['ok']}"
+            f"+{stats['keeper_ok']} failed={stats['failed']}, "
+            f"node kills={stats['node_kills']}, faults={len(faults)} "
+            f"{sorted(fault_kinds)}, leaked entries={max(0, leaked)} "
+            f"bytes={leaked_bytes}"
+        )
+        problems = []
+        if wedged:
+            problems.append(f"wedged futures: {wedged}")
+        if stats["ok"] + stats["keeper_ok"] < 10:
+            problems.append(
+                f"traffic starved: only {stats['ok']} ops completed"
+            )
+        if leaked > 16:
+            problems.append(f"{leaked} directory entries leaked")
+        if leaked_bytes > 8 << 20:
+            problems.append(f"{leaked_bytes} store bytes leaked")
+        if not faults:
+            problems.append("no CHAOS events recorded — engine inactive?")
+        if stats["node_kills"] == 0 and seconds >= 15:
+            problems.append("kill loop never fired")
+        if problems:
+            RESULTS["chaos_soak_ok"] = 0.0
+            raise RuntimeError(
+                f"chaos_soak FAILED (seed={seed}; reproduce with "
+                f"--only chaos_soak --chaos-seed {seed}): "
+                + "; ".join(problems)
+            )
+        RESULTS["chaos_soak_ok"] = 1.0
+    finally:
+        stop.set()
+        # Deactivate chaos before teardown so shutdown paths run clean.
+        os.environ.pop("RAY_TPU_chaos_spec", None)
+        os.environ.pop("RAY_TPU_chaos_seed", None)
+        RayConfig._values["chaos_spec"] = ""
+        RayConfig._values["chaos_seed"] = 0
+        _chaos.install("", 0, RayConfig.testing_rpc_delay_us)
+        for proc in list(cluster._daemons):
+            try:
+                cluster.kill_node(proc)
+            except Exception:  # noqa: BLE001
+                pass
+
+
 def bench_placement_groups():
     from ray_tpu.util.placement_group import (
         placement_group,
@@ -732,7 +1047,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--only", default=None,
         help="comma-separated subset: tasks,actors,objects,pgs,scale,"
-        "object_envelope",
+        "object_envelope,chaos_soak",
     )
     parser.add_argument(
         "--envelope-smoke", action="store_true",
@@ -743,6 +1058,16 @@ def main(argv=None) -> int:
         "--envelope-broadcast-mb", type=int, default=None,
         help="broadcast payload in MiB (default 1024 full / 64 smoke)",
     )
+    parser.add_argument(
+        "--chaos-smoke", action="store_true",
+        help="short seeded chaos_soak config (make chaos-smoke)",
+    )
+    parser.add_argument(
+        "--chaos-seed", type=int, default=None,
+        help="fault-schedule seed (printed on every run; a red run "
+        "reproduces with the same seed)",
+    )
+    parser.add_argument("--chaos-seconds", type=float, default=None)
     args = parser.parse_args(argv)
 
     # Host calibration BEFORE the cluster exists: raw single-thread
@@ -767,6 +1092,11 @@ def main(argv=None) -> int:
         env_cfg["nodes"] = args.envelope_nodes
     if args.envelope_broadcast_mb:
         env_cfg["broadcast_bytes"] = args.envelope_broadcast_mb << 20
+    chaos_cfg = dict(CHAOS_SMOKE if args.chaos_smoke else CHAOS_FULL)
+    if args.chaos_seed is not None:
+        chaos_cfg["seed"] = args.chaos_seed
+    if args.chaos_seconds is not None:
+        chaos_cfg["seconds"] = args.chaos_seconds
     groups = {
         "tasks": bench_tasks,
         "actors": bench_actor_calls,
@@ -774,15 +1104,16 @@ def main(argv=None) -> int:
         "pgs": bench_placement_groups,
         "scale": bench_scale,
         "object_envelope": lambda: bench_object_envelope(env_cfg),
+        "chaos_soak": lambda: bench_chaos_soak(chaos_cfg),
     }
     selected = (
         [s.strip() for s in args.only.split(",")]
         if args.only
-        else [g for g in groups if g != "object_envelope"]
+        else [g for g in groups if g not in ("object_envelope", "chaos_soak")]
     )
     # DaemonCluster nodes need the TCP control plane; harmless otherwise.
     init_kwargs = {"num_cpus": args.num_cpus}
-    if "object_envelope" in selected:
+    if "object_envelope" in selected or "chaos_soak" in selected:
         init_kwargs["tcp_port"] = 0
     ray_tpu.init(**init_kwargs)
     t0 = time.time()

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import chaos as _chaos
 from . import events as _events
 from .config import RayConfig
 from .ids import WorkerID
@@ -482,6 +483,10 @@ class NodeDaemon:
     def _heartbeat_loop(self):
         interval = RayConfig.health_check_period_ms / 1000.0
         while not self._shutdown.wait(interval):
+            # Chaos: node death at the heartbeat boundary — the head
+            # sees silence and must declare the node dead on its own
+            # timer (gcs health loop), never on a clean disconnect.
+            _chaos.kill_point("raylet.heartbeat")
             try:
                 msg = {
                     "type": "node_heartbeat",
@@ -533,8 +538,13 @@ class NodeDaemon:
             proc.terminate()
         try:
             deadline = time.time() + RayConfig.worker_register_timeout_s
+            # Exponential backoff + jitter (the one shared policy):
+            # every daemon in a fleet lost its head at the same
+            # instant, and N synchronized 0.5s probes against a
+            # restarting head is a reconnect stampede.
+            backoff = _chaos.Backoff(base_s=0.25, cap_s=3.0)
             while time.time() < deadline and not self._shutdown.is_set():
-                time.sleep(0.5)
+                time.sleep(backoff.next_delay())
                 try:
                     raw = transport.connect(self.gcs_address, self.authkey)
                 except OSError:
@@ -626,6 +636,11 @@ def main(argv=None):
         help="host for the object transfer listener (default: node IP)",
     )
     args = parser.parse_args(argv)
+
+    # Chaos rule scoping (?role=raylet) + rebuild the schedule now that
+    # the role marker is set (the import-time install saw "driver").
+    os.environ["RAY_TPU_CHAOS_ROLE"] = "raylet"
+    _chaos.refresh()
 
     authkey = bytes.fromhex(
         args.authkey or os.environ.get("RAY_TPU_AUTHKEY", "")

@@ -122,6 +122,32 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
                 )
     except Exception:  # noqa: BLE001 - recorder disabled or old head
         pass
+    try:
+        # Chaos rows (pid "chaos"): every injected fault — message
+        # drop/delay/dup/reorder, connect refusals, process kills —
+        # renders as an instant beside the task/object-plane rows it
+        # perturbed, so a failed chaos run is attributable from the
+        # timeline alone.
+        chaos_events = list_cluster_events(category="chaos", limit=100_000)
+        for ev in chaos_events:
+            trace.append(
+                {
+                    "name": f"{ev['event']}:{ev['entity']}",
+                    "cat": "chaos",
+                    "pid": "chaos",
+                    "tid": ev["event"],
+                    "ph": "i",
+                    "ts": ev["timestamp"] * 1e6,
+                    "s": "g",
+                    "args": {
+                        **(ev.get("attrs") or {}),
+                        "entity": ev["entity"],
+                        "source": ev.get("source", ""),
+                    },
+                }
+            )
+    except Exception:  # noqa: BLE001 - recorder disabled or old head
+        pass
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -23,12 +23,26 @@ def function_id_for(blob: bytes) -> bytes:
 _EMPTY_ARGS_BLOB = serialization.pack(((), {}))
 
 
-def prepare_args(args: tuple, kwargs: dict) -> Tuple[bytes, List[ObjectID]]:
-    """Serialize call args; top-level ObjectRefs become task dependencies."""
+def prepare_args(
+    args: tuple, kwargs: dict
+) -> Tuple[bytes, List[ObjectID], List[ObjectID]]:
+    """Serialize call args. Returns (blob, deps, borrowed):
+
+    - top-level ObjectRefs become task *dependencies* (resolved by the
+      executing worker; gate scheduling for plain tasks);
+    - refs NESTED inside arg values (a list of refs, a dataclass
+      holding one) become *borrowed_refs*: they do not gate scheduling,
+      but the head pins them for the task's lifetime and converts the
+      pin to a borrow edge if the worker retains the ref past the call
+      (reference: borrowed refs are tracked from serialization capture,
+      reference_count.h:61). Without the pin there is an unprotected
+      window — the caller's release can reach the head before the
+      executing worker's batched badd, freeing an object the worker
+      holds (found by the chaos soak as a wedged in-actor get)."""
     if not args and not kwargs:
         # No-arg calls dominate control-plane microbenchmarks; skip the
         # pickle round entirely.
-        return _EMPTY_ARGS_BLOB, []
+        return _EMPTY_ARGS_BLOB, [], []
     deps: List[ObjectID] = []
     for a in args:
         if isinstance(a, ObjectRef):
@@ -38,8 +52,19 @@ def prepare_args(args: tuple, kwargs: dict) -> Tuple[bytes, List[ObjectID]]:
             deps.append(v.id())
     prepared_args = [serialization.prepare_value(a) for a in args]
     prepared_kwargs = {k: serialization.prepare_value(v) for k, v in kwargs.items()}
-    blob = serialization.pack((prepared_args, prepared_kwargs))
-    return blob, deps
+    from ..object_ref import _CaptureRefs
+
+    with _CaptureRefs() as cap:
+        blob = serialization.pack((prepared_args, prepared_kwargs))
+    borrowed: List[ObjectID] = []
+    if cap.seen:
+        top = {d.binary() for d in deps}
+        seen = set()
+        for ob in cap.seen:
+            if ob not in top and ob not in seen:
+                seen.add(ob)
+                borrowed.append(ObjectID(ob))
+    return blob, deps, borrowed
 
 
 def resolve_options(
@@ -103,7 +128,8 @@ def pickle_by_value(obj: Any) -> bytes:
 
 
 def submit_streaming(client, name, function_id, function_blob, args_blob,
-                     deps, resources, actor_id=None, method_name=""):
+                     deps, resources, actor_id=None, method_name="",
+                     borrowed=None):
     """Submit a streaming-generator task (num_returns = -1 sentinel on
     the wire) via the GCS route; returns an ObjectRefGenerator."""
     from .ids import TaskID
@@ -121,6 +147,7 @@ def submit_streaming(client, name, function_id, function_blob, args_blob,
         resources=resources,
         actor_id=actor_id,
         method_name=method_name,
+        borrowed_refs=borrowed or [],
     )
     client.submit(spec)
     return ObjectRefGenerator(

@@ -31,6 +31,12 @@ class TaskSpec:
     # ObjectIDs this task's top-level args depend on; the scheduler holds
     # the task until all are ready.
     dependencies: List[ObjectID] = field(default_factory=list)
+    # Refs NESTED inside arg values (captured at serialization). Never
+    # gate scheduling, but the head pins them for the task's lifetime —
+    # and converts the pin to a borrow edge when the worker retains the
+    # ref — exactly like dependencies (reference: borrowed refs ride
+    # serialization capture, reference_count.h:61).
+    borrowed_refs: List[ObjectID] = field(default_factory=list)
     num_returns: int = 1
     resources: Dict[str, float] = field(default_factory=dict)
     # Actor protocol: creation task pins its worker; method tasks route to
@@ -94,6 +100,7 @@ class TaskSpec:
                 self.runtime_env,
                 self.concurrency_groups,
                 self.concurrency_group,
+                [d._bytes for d in self.borrowed_refs],
             ),
         )
 
@@ -134,6 +141,7 @@ def _rebuild_spec(
     runtime_env,
     concurrency_groups=None,
     concurrency_group=None,
+    borrowed_refs=None,
 ) -> TaskSpec:
     return TaskSpec(
         task_id=TaskID(task_id),
@@ -163,4 +171,5 @@ def _rebuild_spec(
         runtime_env=runtime_env,
         concurrency_groups=concurrency_groups,
         concurrency_group=concurrency_group,
+        borrowed_refs=[ObjectID(d) for d in borrowed_refs or []],
     )

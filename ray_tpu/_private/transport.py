@@ -107,6 +107,12 @@ def listener_address(listener: Listener) -> str:
 
 
 def connect(address: str, authkey: bytes) -> Connection:
+    from . import chaos as _chaos
+
+    if _chaos._active is not None:
+        # Chaos 'connect' rules: delay or refuse establishment — the
+        # failure mode every reconnect/backoff path must absorb.
+        _chaos._active.on_connect(address)
     family, addr = parse_address(address)
     if family == "AF_INET":
         # Challenge-response (sniff-safe) — multiprocessing's client

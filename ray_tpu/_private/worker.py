@@ -89,6 +89,11 @@ def init(
                 return _global.client
             raise RayTpuError("ray_tpu.init() called twice; shutdown() first")
         RayConfig.initialize(_system_config)
+        # Rebuild the chaos schedule from the final config (a
+        # _system_config chaos/delay spec only exists after initialize).
+        from . import chaos as _chaos
+
+        _chaos.refresh()
         if address == "auto":
             # Connect to the machine's running head via its session file
             # (written by `ray-tpu start --head`).

@@ -39,6 +39,7 @@ from typing import Any, Dict, Optional
 
 import cloudpickle
 
+from . import chaos as _chaos
 from . import events as _events
 from . import serialization
 from .client import CoreClient
@@ -65,6 +66,7 @@ def _spec_from_frame(frame) -> TaskSpec:
     s.function_blob = None
     s.args_blob = args_blob
     s.dependencies = []
+    s.borrowed_refs = []
     s.num_returns = nret
     s.resources = {}
     s.actor_creation = False
@@ -187,6 +189,11 @@ class _DoneBatcher:
             ev_items, ev_dropped = rec.attach(msg)
             if not items and not ev_items and not ev_dropped:
                 return
+            if items:
+                # Chaos: worker dies after answering its callers but
+                # before the directory hears the completions — the
+                # early-drop ledger / owner release paths must cope.
+                _chaos.kill_point("worker.pre_task_done")
             from .protocol import ConnectionLost
 
             try:
@@ -916,17 +923,21 @@ class WorkerRuntime:
             "results": results,
             "error": error_blob,
         }
-        if spec.dependencies:
+        pinned_refs = list(spec.dependencies) + list(
+            getattr(spec, "borrowed_refs", None) or ()
+        )
+        if pinned_refs:
             # Borrow piggyback (object plane, reference: borrowed refs
-            # ride the task reply — reference_count.h): dependency refs
-            # this process still holds outlive the task's server-side
-            # pin; report them so the head converts pin -> borrow edge
-            # with no unprotected window. mark_advertised makes the
-            # eventual local drop send its bdel.
+            # ride the task reply — reference_count.h): dependency or
+            # nested arg refs this process still holds outlive the
+            # task's server-side pin; report them so the head converts
+            # pin -> borrow edge with no unprotected window.
+            # mark_advertised makes the eventual local drop send its
+            # bdel.
             tracker = self.client._tracker
             held = {
                 d.binary()
-                for d in spec.dependencies
+                for d in pinned_refs
                 if tracker.holds(d.binary())
             }
             if held:
@@ -939,6 +950,14 @@ class WorkerRuntime:
             msg["actor_creation"] = True
             msg["actor_id"] = spec.actor_id.binary()
         self.client.send(msg)
+        if _chaos._active is not None:
+            # Chaos: named per-task kill point — "kill the owner
+            # between SEAL and REF_FLUSH" targets exactly the task
+            # whose returns this process now owns (the caller observed
+            # completion; this process's authoritative refcounts die
+            # unflushed). Guarded: the f-string must not run on the
+            # per-task hot path when chaos is off.
+            _chaos.kill_point(f"worker.post_exec.{spec.name}")
 
     def _execute(self, spec: TaskSpec, origin=None):
         _rec = _events.get_recorder()

@@ -67,7 +67,14 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs) -> Union[ObjectRef, List[ObjectRef]]:
         client = global_client()
-        args_blob, deps = _submit.prepare_args(args, kwargs)
+        args_blob, deps, borrowed = _submit.prepare_args(args, kwargs)
+        if borrowed:
+            # Actor-method deps never gate dispatch (the pinned worker
+            # resolves args itself), so nested refs can ride the same
+            # pin path as top-level ones: client-side pinning on the
+            # direct route, head-side task_pins + pin→borrow conversion
+            # on the GCS route.
+            deps = deps + borrowed
         if self._num_returns in ("streaming", "dynamic"):
             # Streaming actor method: GCS-routed so the pinned worker's
             # stream_item reports and ordered dispatch share a channel.
@@ -199,7 +206,7 @@ class ActorClass:
                     return ActorHandle(ActorID(reply["actor_id"]), self._function_id)
                 raise ValueError(f"Actor name '{name}' is already taken")
         try:
-            args_blob, deps = _submit.prepare_args(args, kwargs)
+            args_blob, deps, borrowed = _submit.prepare_args(args, kwargs)
         except BaseException:
             if name:
                 client.send(
@@ -226,6 +233,7 @@ class ActorClass:
             function_blob=client.register_function_once(self._function_id, self._blob),
             args_blob=args_blob,
             dependencies=deps,
+            borrowed_refs=borrowed,
             num_returns=1,
             resources=_submit.resources_from_options(opts, is_actor=True),
             actor_creation=True,

@@ -97,7 +97,7 @@ class RemoteFunction:
         client = global_client()
         self._ensure_pickled()
         opts = self._default_options
-        args_blob, deps = _submit.prepare_args(args, kwargs)
+        args_blob, deps, borrowed = _submit.prepare_args(args, kwargs)
         num_returns = self._num_returns
         if num_returns in ("streaming", "dynamic"):
             # Streaming generator: each yield seals as its own object,
@@ -109,6 +109,7 @@ class RemoteFunction:
                 client, self._fn.__name__, self._function_id,
                 client.register_function_once(self._function_id, self._blob),
                 args_blob, deps, _submit.resources_from_options(opts),
+                borrowed=borrowed,
             )
         if self._simple:
             from .util import tracing
@@ -126,6 +127,7 @@ class RemoteFunction:
                 )
                 spec.args_blob = args_blob
                 spec.dependencies = deps
+                spec.borrowed_refs = borrowed
                 spec.num_returns = num_returns
                 spec.resources = self._resources
                 spec.actor_creation = False
@@ -163,6 +165,7 @@ class RemoteFunction:
             function_blob=client.register_function_once(self._function_id, self._blob),
             args_blob=args_blob,
             dependencies=deps,
+            borrowed_refs=borrowed,
             num_returns=num_returns,
             resources=_submit.resources_from_options(opts),
             max_retries=(

@@ -1,0 +1,397 @@
+"""Chaos engine + resilience primitives.
+
+Reference model: python/ray/tests/test_chaos.py (resource killers over
+nodes/workers) + RAY_testing_asio_delay_us (ray_config_def.h:832),
+generalized here into the seeded FaultSchedule (_private/chaos.py)
+woven into the transport boundary and named process kill points, plus
+the shared Backoff/retry and in-order ref_flush sequencing the
+hardened failure paths ride.
+"""
+import gc
+import random
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu._private.chaos import (
+    Backoff,
+    FaultSchedule,
+    InOrderSequencer,
+    retry_call,
+)
+
+
+class _Holder:
+    """Stands in for a PeerConn as the reorder hold slot."""
+
+
+# ------------------------------------------------------------ determinism
+
+
+def _decision_trace(schedule: FaultSchedule, mtype: str, n: int):
+    return [schedule.decide(mtype) for _ in range(n)]
+
+
+def test_same_seed_same_injection_sequence():
+    """Acceptance: every fault rule is deterministic under a fixed
+    seed — the nth decision for a message type is a pure function of
+    (seed, rule, n)."""
+    spec = "ref_flush=drop:0.3,pull_chunk=delay:0.5:1000:9000,x=dup:0.2"
+    a = _decision_trace(FaultSchedule(spec, seed=42), "ref_flush", 200)
+    b = _decision_trace(FaultSchedule(spec, seed=42), "ref_flush", 200)
+    assert a == b
+    assert any(d is not None for d in a)  # p=0.3 over 200 draws fires
+    c = _decision_trace(FaultSchedule(spec, seed=43), "ref_flush", 200)
+    assert a != c  # a different seed is a different schedule
+    # Delay magnitudes are part of the deterministic stream too.
+    d1 = _decision_trace(FaultSchedule(spec, seed=7), "pull_chunk", 50)
+    d2 = _decision_trace(FaultSchedule(spec, seed=7), "pull_chunk", 50)
+    assert d1 == d2
+
+
+def test_rule_limit_and_unknown_types():
+    s = FaultSchedule("a=drop:1.0@2", seed=1)
+    assert [s.decide("a") is not None for _ in range(4)] == [
+        True, True, False, False,
+    ]
+    assert s.decide("never-mentioned") is None
+    with pytest.raises(ValueError):
+        FaultSchedule("a=explode:1.0", seed=1)
+
+
+def test_intercept_actions():
+    s = FaultSchedule(
+        "d=drop:1.0,u=dup:1.0,r=reorder:1.0@1,n=delay:1.0:1:1", seed=5
+    )
+    h = _Holder()
+    assert s.intercept(h, "d", {"type": "d"}) == []
+    assert s.intercept(h, "u", {"type": "u"}) == [
+        {"type": "u"}, {"type": "u"},
+    ]
+    # Reorder: held until the NEXT message on the conn, then delivered
+    # right after it (a one-slot swap).
+    assert s.intercept(h, "r", {"type": "r", "i": 1}) == []
+    out = s.intercept(h, "x", {"type": "x"})
+    assert out == [{"type": "x"}, {"type": "r", "i": 1}]
+    # A close drains anything still held — never a silent drop.
+    assert s.intercept(h, "r", {"type": "r", "i": 2}) == [
+        {"type": "r", "i": 2}
+    ]  # @1 limit: second reorder rule application doesn't fire
+    s2 = FaultSchedule("r=reorder:1.0", seed=5)
+    h2 = _Holder()
+    assert s2.intercept(h2, "r", {"i": 1}) == []
+    assert s2.drain_held(h2) == [{"i": 1}]
+    assert s2.drain_held(h2) == []
+
+
+def test_kill_rule_fires_on_nth_hit(monkeypatch):
+    s = FaultSchedule("kill:owner.pre_ref_flush=3", seed=9)
+    killed = []
+    monkeypatch.setattr(
+        FaultSchedule, "_kill", lambda self: killed.append(True)
+    )
+    for _ in range(5):
+        s.maybe_kill("owner.pre_ref_flush")
+    assert len(killed) == 1  # exactly the 3rd hit
+    s.maybe_kill("some.other.point")
+    assert len(killed) == 1
+
+
+def test_role_scoped_rules(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CHAOS_ROLE", "raylet")
+    s = FaultSchedule("a=drop:1.0?role=worker,b=drop:1.0?role=raylet",
+                      seed=2)
+    assert s.decide("a") is None  # scoped to workers; we are a raylet
+    assert s.decide("b") is not None
+
+
+def test_legacy_delay_spec_translation():
+    s = FaultSchedule("", seed=0,
+                      legacy_delay_spec="put_object=5000:5000")
+    d = s.decide("put_object")
+    assert d is not None and d[0] == "delay"
+    assert abs(d[1] - 0.005) < 1e-9
+
+
+# --------------------------------------------------------------- backoff
+
+
+def test_backoff_growth_jitter_and_budget():
+    bo = Backoff(base_s=0.1, cap_s=1.0, rng=random.Random(3))
+    delays = [bo.next_delay() for _ in range(20)]
+    assert all(d <= 1.0 for d in delays)
+    assert all(d >= 0.025 for d in delays)  # base/4 floor
+    # Deterministic under a seeded rng.
+    bo2 = Backoff(base_s=0.1, cap_s=1.0, rng=random.Random(3))
+    assert delays == [bo2.next_delay() for _ in range(20)]
+    # Budget bounds total sleep.
+    bo3 = Backoff(base_s=10.0, cap_s=10.0, budget_s=0.01,
+                  rng=random.Random(1))
+    assert bo3.next_delay() <= 0.01
+    assert bo3.exhausted()
+    bo3.reset()
+    assert not bo3.exhausted()
+
+
+def test_retry_call_retries_then_raises():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    bo = Backoff(base_s=0.001, cap_s=0.002, rng=random.Random(0))
+    assert retry_call(flaky, backoff=bo) == "ok"
+    assert len(calls) == 3
+
+    def always():
+        raise OSError("nope")
+
+    with pytest.raises(OSError):
+        retry_call(
+            always,
+            backoff=Backoff(base_s=0.001, cap_s=0.002, budget_s=0.01),
+        )
+
+
+# -------------------------------------------------------------- sequencer
+
+
+def test_sequencer_orders_dedups_and_skips_gaps():
+    sq = InOrderSequencer(gap_timeout_s=10.0)
+    assert sq.offer(1, "a", now=0.0) == ["a"]
+    assert sq.offer(3, "c", now=0.0) == []          # gap: buffered
+    assert sq.offer(2, "b", now=0.0) == ["b", "c"]  # fills in order
+    assert sq.offer(2, "b", now=0.0) == []          # duplicate
+    assert sq.duplicates == 1
+    # A gap that never fills is skipped after the timeout — flushed in
+    # order, counted.
+    assert sq.offer(6, "f", now=1.0) == []
+    assert sq.offer(7, "g", now=20.0) == ["f", "g"]
+    assert sq.skipped_gaps == 1
+    assert sq.offer(8, "h", now=21.0) == ["h"]
+
+
+def test_sequencer_baseline_is_first_seen():
+    sq = InOrderSequencer()
+    # Without start_seq (mid-stream attach): first seq seen is the
+    # baseline, not 1.
+    assert sq.offer(40, "x") == ["x"]
+    assert sq.offer(41, "y") == ["y"]
+
+
+def test_sequencer_start_seq_accepts_retransmitted_first_batch():
+    """Regression: the FIRST batch dropped in transit must read as a
+    gap awaiting its retransmit — with a first-seen baseline the later
+    seq=1 retransmit would be discarded as a 'duplicate' (and its
+    edges silently lost, despite having been acked)."""
+    sq = InOrderSequencer(gap_timeout_s=10.0, start_seq=1)
+    assert sq.offer(2, "b", now=0.0) == []   # seq 1 was dropped: gap
+    assert sq.offer(1, "a", now=1.0) == ["a", "b"]  # retransmit lands
+    assert sq.duplicates == 0
+
+
+# ------------------------------------------------- ref_flush at-least-once
+
+
+class _FakeConn:
+    closed = False
+
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+
+class _FakeClient:
+    def __init__(self):
+        from ray_tpu._private.ids import WorkerID
+
+        self.worker_id = WorkerID.from_random()
+        self.conn = _FakeConn()
+        self._lineage = {}
+
+    def _wait_prune(self, oids):
+        pass
+
+
+def test_ref_flush_carries_seq_and_retransmits_until_acked():
+    from ray_tpu._private.object_plane import owner_refs
+    from ray_tpu._private.object_plane.owner_refs import OwnerRefTracker
+
+    c = _FakeClient()
+    t = OwnerRefTracker(c)
+    oid = b"owned111"
+    t.incr(oid, c.worker_id.binary())
+    t.mark_advertised(oid)
+    t.decr(oid)
+    t.flush(c)
+    (msg,) = c.conn.sent
+    assert msg["seq"] == 1 and msg["release"] == [oid]
+    # Unacked: ages past RETRANSMIT_S -> the next flush resends the
+    # SAME batch (same seq — the head sequencer dedups).
+    with t._lock:
+        t._unacked[1][1] -= owner_refs.RETRANSMIT_S + 1
+    t.flush(c)
+    assert len(c.conn.sent) == 2 and c.conn.sent[1]["seq"] == 1
+    assert t.stats["retransmits"] == 1
+    # Ack clears it: no further resends.
+    t.ack(1)
+    with t._lock:
+        assert not t._unacked
+    t.flush(c)
+    assert len(c.conn.sent) == 2
+    t.stop()
+
+
+def test_ref_flush_lost_batch_counted_after_max_attempts():
+    from ray_tpu._private.object_plane import owner_refs
+    from ray_tpu._private.object_plane.owner_refs import OwnerRefTracker
+
+    c = _FakeClient()
+    t = OwnerRefTracker(c)
+    t.incr(b"borrowed", b"o" * 16)
+    t.flush(c)
+    with t._lock:
+        t._unacked[1][2] = owner_refs.RETRANSMIT_MAX  # attempts spent
+        t._unacked[1][1] -= owner_refs.RETRANSMIT_S + 1
+    t.flush(c)
+    with t._lock:
+        assert not t._unacked
+    assert t.stats["lost_batches"] == 1
+    t.stop()
+
+
+def test_dead_borrower_late_add_ignored():
+    """borrower_died sweep racing a delayed/reordered head→owner relay:
+    the late add must not resurrect a borrow edge nothing will ever
+    retract."""
+    from ray_tpu._private.object_plane.owner_refs import OwnerRefTracker
+
+    c = _FakeClient()
+    t = OwnerRefTracker(c)
+    oid = b"owned111"
+    t.incr(oid, c.worker_id.binary())
+    t.mark_advertised(oid)
+    t.sweep_borrower(b"b" * 16)
+    t.apply_borrow_update(b"b" * 16, [oid], [])  # the late relay
+    assert t.stats["stale_borrow_adds"] == 1
+    t.decr(oid)
+    t.flush(c)
+    # The release still goes out — the stale edge held nothing.
+    assert any(m.get("release") == [oid] for m in c.conn.sent)
+    t.stop()
+
+
+# ----------------------------------------------------------- end to end
+
+
+def test_chaos_delay_rule_via_system_config():
+    """The chaos engine subsumes testing_rpc_delay_us: a delay rule on
+    put_object visibly stretches the put round-trip."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "chaos_spec": "put_object=delay:1.0:30000:30000",
+            "chaos_seed": 11,
+        },
+    )
+    try:
+        start = time.monotonic()
+        ray_tpu.get(ray_tpu.put(1))
+        assert time.monotonic() - start >= 0.03
+        assert chaos.active() is not None
+        assert chaos.active().stats.get("delay:put_object", 0) >= 1
+    finally:
+        ray_tpu.shutdown()
+        chaos.install("", 0)
+
+
+def test_dropped_ref_flush_batches_still_release(monkeypatch):
+    """At-least-once flush end to end: with the first TWO ref_flush
+    deliveries deterministically dropped at the head's transport
+    boundary, retransmission still lands the release and the entry
+    frees — and the injected faults surface as CHAOS events."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "chaos_spec": "ref_flush=drop:1.0@2",
+            "chaos_seed": 21,
+        },
+    )
+    try:
+        from ray_tpu._private.worker import _global, global_client
+
+        import numpy as np
+
+        client = global_client()
+        ref = ray_tpu.put(np.zeros(300_000))
+        oid = ref.id().binary()
+        client._tracker.flush(client)
+        del ref
+        gc.collect()
+        client._tracker.flush(client)
+        gcs = _global.node.gcs
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if gcs.objects.get(oid) is None:
+                break
+            time.sleep(0.1)
+        assert gcs.objects.get(oid) is None, (
+            "release lost to dropped ref_flush batches",
+            client._tracker.stats,
+        )
+        from ray_tpu.util.state import list_cluster_events
+
+        drops = list_cluster_events(category="chaos", event="DROP",
+                                    limit=10)
+        assert drops, "injected drops not visible as CHAOS events"
+    finally:
+        ray_tpu.shutdown()
+        chaos.install("", 0)
+
+
+def test_oom_victim_ordering_groups_by_owner():
+    """Satellite: the kill ladder's group-by-owner fairness tier — the
+    job with the burst pays, not the job with one task; retriability
+    and newest-first break ties inside the group."""
+    from types import SimpleNamespace
+
+    from ray_tpu._private.gcs import W_BUSY, W_LEASED, sort_oom_victims
+
+    def w(owner, started, retries=1, state=W_BUSY):
+        return SimpleNamespace(
+            state=state,
+            task_started_at=started,
+            current_task=SimpleNamespace(
+                max_retries=retries, owner_client=owner, name="t",
+            ),
+        )
+
+    job_a = b"a" * 16
+    job_b = b"b" * 16
+    burst = [w(job_a, 10.0), w(job_a, 20.0), w(job_a, 30.0)]
+    single = [w(job_b, 40.0)]
+    order = sort_oom_victims(single + burst)
+    # All of job A's burst dies before job B's single task is touched.
+    assert [getattr(v.current_task, "owner_client") for v in order[:3]] \
+        == [job_a] * 3
+    assert order[0].task_started_at == 30.0  # newest in the big group
+    assert order[-1].current_task.owner_client == job_b
+    # Within one group, retriable ranks before non-retriable.
+    mixed = [w(job_a, 10.0, retries=0), w(job_a, 5.0, retries=2)]
+    order2 = sort_oom_victims(mixed)
+    assert order2[0].current_task.max_retries == 2
+    # Leased workers (no visible task) rank between the two.
+    leased = SimpleNamespace(
+        state=W_LEASED, task_started_at=50.0, current_task=None
+    )
+    order3 = sort_oom_victims(
+        [w(job_a, 1.0, retries=0), leased]
+    )
+    assert order3[0].state == W_LEASED

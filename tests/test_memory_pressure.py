@@ -92,6 +92,88 @@ def test_oom_kills_nonretriable_with_oom_error(tmp_path):
         ray_tpu.shutdown()
 
 
+def test_oom_group_by_owner_fairness_two_jobs(tmp_path):
+    """Kill-ladder fairness tier (reference:
+    worker_killing_policy_group_by_owner.h): under memory pressure with
+    job A running a 3-task burst (submitted from inside a worker — its
+    own owner/client id) and job B running one task (the driver), the
+    victim comes from job A's burst. Job B's single task must complete
+    without ever being killed."""
+    usage_file = tmp_path / "usage"
+    usage_file.write_text("0.10")
+    ray_tpu.init(
+        num_cpus=8,
+        ignore_reinit_error=True,
+        _system_config={
+            "testing_memory_usage_file": str(usage_file),
+            "memory_usage_threshold": 0.9,
+            "memory_monitor_refresh_ms": 300,
+        },
+    )
+    try:
+        flag_a = str(tmp_path / "job_a_attempts")
+        flag_b = str(tmp_path / "job_b_attempts")
+
+        @ray_tpu.remote(max_retries=3)
+        def hog(path, dep, hold_s):
+            with open(path, "a") as f:
+                f.write("attempt\n")
+            t0 = time.time()
+            while time.time() - t0 < hold_s:
+                time.sleep(0.05)
+            return "done"
+
+        @ray_tpu.remote(max_retries=0)
+        def spawner(path, dep):
+            # Job A: this worker process is the submitting client for
+            # three hogs (a dep ref keeps them on the GCS route, where
+            # the monitor can see and target them).
+            d2 = ray_tpu.put(b"y")
+            refs = [hog.remote(path, d2, 6.0) for _ in range(3)]
+            return ray_tpu.get(refs, timeout=90)
+
+        dep = ray_tpu.put(b"x")
+        b_ref = hog.remote(flag_b, dep, 5.0)  # job B: one task
+        s_ref = spawner.remote(flag_a, dep)
+        # Wait until all four hogs are running.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            a_n = (
+                len(open(flag_a).readlines())
+                if os.path.exists(flag_a)
+                else 0
+            )
+            b_n = (
+                len(open(flag_b).readlines())
+                if os.path.exists(flag_b)
+                else 0
+            )
+            if a_n >= 3 and b_n >= 1:
+                break
+            time.sleep(0.1)
+        assert a_n >= 3 and b_n >= 1, "hogs never started"
+        time.sleep(0.3)
+        usage_file.write_text("0.97")  # one-ish monitor tick of pressure
+        time.sleep(0.45)
+        usage_file.write_text("0.10")
+        # Both jobs complete; the burst (job A) absorbed the kill(s).
+        assert ray_tpu.get(b_ref, timeout=60) == "done"
+        assert ray_tpu.get(s_ref, timeout=120) == ["done"] * 3
+        with open(flag_a) as f:
+            a_attempts = len(f.readlines())
+        with open(flag_b) as f:
+            b_attempts = len(f.readlines())
+        assert b_attempts == 1, (
+            f"job B's single task was killed ({b_attempts} attempts) "
+            "while job A ran a 3-task burst"
+        )
+        assert a_attempts >= 4, (
+            "no job-A task was killed — the pressure tick never fired?"
+        )
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_oom_prefers_retriable_and_resubmits(tmp_path):
     usage_file = tmp_path / "usage"
     usage_file.write_text("0.10")

@@ -12,6 +12,7 @@ import time
 import pytest
 
 import ray_tpu
+from ray_tpu.exceptions import RayActorError
 from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.object_plane import directory as objdir
 from ray_tpu._private.object_plane.directory import ShardedObjectDirectory
@@ -438,6 +439,205 @@ def test_drop_racing_delayed_task_done_reclaims_on_sharded_path():
         assert gcs.objects.stats["early_drops"] > 0
     finally:
         ray_tpu.shutdown()
+
+
+def test_owner_death_with_unflushed_ref_flush_batch():
+    """Owner-death edge (chaos engine, deterministic): the driver's
+    badd for an actor-owned object is DROPPED at the head (first two
+    ref_flush deliveries), the owner dies before the retransmit lands,
+    and the promoted entry must survive on the owner-death grace window
+    until the retransmitted borrow edge arrives — then free normally
+    once the borrow drops. Without the grace + at-least-once flush the
+    head frees a live borrowed object."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "chaos_spec": "ref_flush=drop:1.0@2",
+            "chaos_seed": 33,
+            "owner_death_grace_s": 6.0,
+        },
+    )
+    try:
+        import numpy as np
+
+        @ray_tpu.remote
+        class Owner:
+            def make(self):
+                self.ref = ray_tpu.put(np.zeros(300_000))
+                return [self.ref]
+
+        o = Owner.remote()
+        [ref] = ray_tpu.get(o.make.remote(), timeout=30)
+        oid = ref.id()
+        _flush_refs()  # the badd batch — dropped at the head
+        ray_tpu.kill(o)  # owner dies with the borrow edge un-landed
+        gcs = _global.node.gcs
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            e = gcs.objects.get(oid.binary())
+            if e is not None and e.owner is None:
+                break
+            time.sleep(0.05)
+        e = gcs.objects.get(oid.binary())
+        assert e is not None, (
+            "promoted entry freed during the grace window with the "
+            "borrow edge still in flight"
+        )
+        # The retransmitted badd lands within a couple of retransmit
+        # periods — well inside the grace window — as a holder shadow.
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            e = gcs.objects.get(oid.binary())
+            if e is not None and e.holders:
+                break
+            time.sleep(0.1)
+        assert e is not None and e.holders, "borrow edge never landed"
+        # Borrowed data still readable after the owner's death.
+        assert ray_tpu.get(ref, timeout=30).shape == (300_000,)
+        del ref
+        gc.collect()
+        _flush_refs()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if gcs.objects.get(oid.binary()) is None:
+                break
+            time.sleep(0.1)
+        assert gcs.objects.get(oid.binary()) is None, (
+            "promoted entry leaked after its last borrow dropped"
+        )
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private import chaos as _chaos
+
+        _chaos.install("", 0)
+
+
+def test_borrower_dies_during_head_owner_relay():
+    """The head→owner borrow relay reordered past the borrower's death
+    (chaos reorder rule at the owner's deliver side): the owner must
+    ignore the stale add — a borrow edge for a dead process would hold
+    the object forever."""
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "chaos_spec": "borrow_update=reorder:1.0@1?role=driver",
+            "chaos_seed": 44,
+        },
+    )
+    try:
+        import numpy as np
+
+        @ray_tpu.remote
+        class Keeper:
+            def keep(self, refs):
+                self.refs = refs
+                return True
+
+        k = Keeper.remote()
+        ref = ray_tpu.put(np.ones(300_000))  # driver owns X
+        oid = ref.id()
+        assert ray_tpu.get(k.keep.remote([ref]), timeout=30)
+        # The relay's add for this borrow is held in the reorder slot;
+        # killing the borrower makes borrower_died overtake it.
+        ray_tpu.kill(k)
+        time.sleep(1.0)  # let the sweep + (stale) relay both land
+        del ref
+        gc.collect()
+        _flush_refs()
+        gcs = _global.node.gcs
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if gcs.objects.get(oid.binary()) is None:
+                break
+            time.sleep(0.1)
+        client = global_client()
+        assert gcs.objects.get(oid.binary()) is None, (
+            "stale borrow edge for a dead borrower held the object",
+            client._tracker.stats,
+        )
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private import chaos as _chaos
+
+        _chaos.install("", 0)
+
+
+def test_flap_across_owner_restart(monkeypatch):
+    """1→0→1 instance flap on a borrowed ref across its owner's death
+    and restart, with the owner killed at a deterministic chaos kill
+    point ('between SEAL and REF_FLUSH': right after reporting
+    Owner.make done, before its ref flush). The flapped ref must stay
+    readable on the promoted entry and free exactly once at the end."""
+    # Worker kill points activate from the environment (spawned worker
+    # processes read RAY_TPU_chaos_* at import).
+    monkeypatch.setenv(
+        # Actor-method specs are named by bare method name.
+        "RAY_TPU_chaos_spec", "kill:worker.post_exec.make=1"
+    )
+    monkeypatch.setenv("RAY_TPU_chaos_seed", "55")
+    ray_tpu.init(num_cpus=2)
+    try:
+        import numpy as np
+
+        @ray_tpu.remote(max_restarts=1)
+        class Owner:
+            def make(self):
+                self.ref = ray_tpu.put(np.zeros(300_000))
+                return [self.ref]
+
+            def ping(self):
+                return "pong"
+
+        o = Owner.remote()
+        [ref] = ray_tpu.get(o.make.remote(), timeout=60)
+        oid = ref.id()
+        owner_b = ref._owner
+        gcs = _global.node.gcs
+        # The chaos kill point took the owner down right after the
+        # reply; wait for promotion (owner=None).
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            e = gcs.objects.get(oid.binary())
+            if e is not None and e.owner is None:
+                break
+            time.sleep(0.1)
+        e = gcs.objects.get(oid.binary())
+        assert e is not None and e.owner is None, "owner never promoted"
+        # Flap 1→0→1 within one flush window across the restart.
+        del ref
+        ref = ray_tpu.ObjectRef(oid, owner_b)
+        gc.collect()
+        _flush_refs()
+        time.sleep(0.3)
+        assert gcs.objects.get(oid.binary()) is not None, (
+            "flapped borrow freed a live promoted object"
+        )
+        assert ray_tpu.get(ref, timeout=30).shape == (300_000,)
+        # The actor itself restarted and is usable.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                assert ray_tpu.get(o.ping.remote(), timeout=10) == "pong"
+                break
+            except RayActorError:
+                time.sleep(0.2)
+        else:
+            pytest.fail("owner actor did not restart")
+        # Final drop frees exactly once.
+        del ref
+        gc.collect()
+        _flush_refs()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if gcs.objects.get(oid.binary()) is None:
+                break
+            time.sleep(0.1)
+        assert gcs.objects.get(oid.binary()) is None
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private import chaos as _chaos
+
+        _chaos.install("", 0)
 
 
 def test_stream_items_freed_after_consumption(ray2):
