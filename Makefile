@@ -2,9 +2,10 @@
 # .buildkite/ + ci/ — here one deterministic make surface: native
 # build, bytecode lint, stress binaries, full suite).
 
-.PHONY: ci native lint test obs-smoke envelope-smoke chaos-smoke stress clean
+.PHONY: ci native lint test obs-smoke envelope-smoke chaos-smoke \
+	failover-smoke stress clean
 
-ci: native lint test obs-smoke envelope-smoke chaos-smoke
+ci: native lint test obs-smoke envelope-smoke chaos-smoke failover-smoke
 
 native:
 	$(MAKE) -C native
@@ -56,6 +57,22 @@ chaos-smoke:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
 		--only chaos_soak --chaos-smoke \
 		--out /tmp/ray_tpu_chaos_smoke.json
+
+# Head-failover smoke, short + seeded (1 supervised-head SIGKILL under
+# task/actor/object traffic on a 2-daemon cluster, bounded wall time).
+# Asserts zero wedged gets, actor + kv continuity across the restart,
+# no leaked directory entries, and visible HEAD/RECONCILE events. A
+# red run reproduces with
+#   python -m ray_tpu._private.ray_perf --only head_failover \
+#       --failover-smoke --chaos-seed <printed seed>
+# A host that cannot launch the external head records an explicit
+# head_failover_skipped row — counted, never silent. The full
+# multi-kill soak:
+#   python -m ray_tpu._private.ray_perf --only head_failover
+failover-smoke:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
+		--only head_failover --failover-smoke \
+		--out /tmp/ray_tpu_failover_smoke.json
 
 stress:
 	$(MAKE) -C native stress-asan

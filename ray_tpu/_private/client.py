@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import chaos as _chaos
 from . import serialization
 from . import events as _events
 from . import fastpath as _fastpath
@@ -46,6 +47,7 @@ class CoreClient:
         push_handler: Optional[Callable[[Dict[str, Any]], None]] = None,
         transfer_addr: Optional[str] = None,
         direct_addr: Optional[str] = None,
+        reconnect: Optional[bool] = None,
     ):
         from . import transport
         from .object_transfer import ObjectFetcher
@@ -61,32 +63,59 @@ class CoreClient:
         # thread is busy carrying the request; gcs._barrier_flush_events).
         self.pre_state_read_flush: Optional[Callable[[], None]] = None
         self._push_handler = push_handler or (lambda msg: None)
-        conn = transport.connect(address, authkey)
+        self._address = address
+        self._authkey = authkey
+        self._transfer_addr = transfer_addr
+        self._direct_addr = direct_addr
+        # Head failover (reference: gcs_rpc_client retries across a GCS
+        # restart). Workers always ride a failover — their head (the
+        # same session address, unix or TCP) may be restarted by a
+        # supervisor; drivers opt in when they connected to an external
+        # head (an in-process head dies with this process).
+        self._reconnect_enabled = (
+            role == "worker" if reconnect is None else bool(reconnect)
+        )
+        self._closing = False
+        self._reconnecting = False
+        self._reconnect_lock = threading.Lock()
+        self._conn_gen = 0
+        #: Set when the head is gone for good (reconnect disabled,
+        #: budget exhausted, or close()): watchers exit on this.
+        self.head_permanently_lost = threading.Event()
+        #: Worker runtime hooks: extra reconnect-hello payload (hosted
+        #: actors, executing tasks, sealed locations) and a post-
+        #: reconnect callback (done-batcher retransmit, drop_actors).
+        self.reconcile_info: Optional[Callable[[], Dict[str, Any]]] = None
+        self.on_reconnected: Optional[
+            Callable[[Dict[str, Any]], None]
+        ] = None
+        self.done_ack: Optional[Callable[[int], None]] = None
+        # Initial connect: ONE retry policy (chaos.Backoff, full
+        # jitter) instead of failing on the first refused connect — a
+        # worker spawned while the head restarts, or a driver racing
+        # head bring-up, must absorb the same failure mode the
+        # reconnect path does (reconnect stampede note in raylet.py).
+        bo = _chaos.Backoff(
+            base_s=0.1, cap_s=2.0,
+            budget_s=(
+                RayConfig.worker_register_timeout_s
+                if role == "worker"
+                else 5.0
+            ),
+        )
+        conn = _chaos.retry_call(
+            lambda: transport.connect(address, authkey),
+            retry_on=(OSError,),
+            backoff=bo,
+        )
         self.conn = PeerConn(
             conn,
             push_handler=self._on_push,
-            on_close=self._on_head_conn_close,
+            on_close=lambda gen=0: self._on_head_conn_close(gen),
             name=f"client-{role}",
         )
-        hello = {
-            "type": "hello",
-            "role": role,
-            "worker_id": self.worker_id.binary(),
-            "pid": os.getpid(),
-        }
-        if transfer_addr:
-            hello["transfer_addr"] = transfer_addr
-        if direct_addr:
-            hello["direct_addr"] = direct_addr
-        nid_hex = os.environ.get("RAY_TPU_NODE_ID")
-        if nid_hex:
-            hello["node_id"] = bytes.fromhex(nid_hex)
-        if os.environ.get("RAY_TPU_LOCAL_ONLY"):
-            # Raylet-leased worker: the daemon dispatches to us, the GCS
-            # only keeps directory/worker bookkeeping.
-            hello["local_only"] = True
         reply = self.conn.request(
-            hello, timeout=RayConfig.worker_register_timeout_s
+            self._hello_msg(), timeout=RayConfig.worker_register_timeout_s
         )
         if not reply.get("ok"):
             raise RayTpuError(f"failed to register with GCS: {reply}")
@@ -95,7 +124,6 @@ class CoreClient:
         # other nodes are pulled through the transfer plane.
         self.node_id: Optional[bytes] = reply.get("node_id")
         self._fetcher = ObjectFetcher(self.store, authkey)
-        self._authkey = authkey
         self._registered_functions: set = set()
         self._fn_lock = threading.Lock()
         # Direct actor-call path (reference: actor calls bypass raylets,
@@ -189,7 +217,7 @@ class CoreClient:
         # has buffered frames — an idle process must cost zero wakeups
         # (hundreds of workers x a 2 ms timer would saturate a core on
         # their own; see the 150-actor scale stress).
-        while not self.conn.closed:
+        while self._running():
             busy = False
             for c in tuple(self._lazy_conns):
                 if c.has_buffered:
@@ -209,13 +237,250 @@ class CoreClient:
             self._lazy_evt.clear()
             self._lazy_parked = False
 
-    def _on_head_conn_close(self) -> None:
+    # ------------------------------------------------------- head failover
+    # Reference: gcs_rpc_client.h retries RPCs across a GCS restart and
+    # bearers of truth re-report via NotifyGCSRestart. Here: on conn
+    # loss, a reconnect thread re-dials the SAME head address with
+    # chaos.Backoff, re-registers under the same worker/job id
+    # (hello reconnect=True), then replays in-flight state — wait
+    # re-subscriptions, owned-object reconciliation, unacked
+    # ref_flush/task_done batches (per-batch seq + head-side dedup make
+    # retransmission safe). Blocked get()/wait() callers park on the
+    # failover instead of raising.
+
+    def _hello_msg(self, reconnect: bool = False) -> Dict[str, Any]:
+        hello: Dict[str, Any] = {
+            "type": "hello",
+            "role": self.role,
+            "worker_id": self.worker_id.binary(),
+            "pid": os.getpid(),
+        }
+        if self._transfer_addr:
+            hello["transfer_addr"] = self._transfer_addr
+        if self._direct_addr:
+            hello["direct_addr"] = self._direct_addr
+        nid_hex = os.environ.get("RAY_TPU_NODE_ID")
+        if nid_hex:
+            hello["node_id"] = bytes.fromhex(nid_hex)
+        if os.environ.get("RAY_TPU_LOCAL_ONLY"):
+            # Raylet-leased worker: the daemon dispatches to us, the GCS
+            # only keeps directory/worker bookkeeping.
+            hello["local_only"] = True
+        if reconnect:
+            hello["reconnect"] = True
+            info = self.reconcile_info
+            if info is not None:
+                try:
+                    hello.update(info())
+                except Exception:  # noqa: BLE001 - reconcile is best-effort
+                    pass
+        return hello
+
+    def _on_head_conn_close(self, gen: int = -1) -> None:
+        if gen >= 0 and gen != self._conn_gen:
+            return  # a superseded connection's late close: ignore
         # Blocked waiters must observe head loss (the old polling wait
         # raised out of its per-iteration request; push-based waits
         # would otherwise sleep forever on the condvar).
         with self._wait_cond:
             self._head_conn_lost = True
             self._wait_cond.notify_all()
+        if self._closing or not self._reconnect_enabled:
+            self.head_permanently_lost.set()
+            return
+        with self._reconnect_lock:
+            if self._reconnecting or self._closing:
+                return
+            self._reconnecting = True
+        threading.Thread(
+            target=self._reconnect_loop, name="head-reconnect", daemon=True
+        ).start()
+
+    def conn_failover_pending(self) -> bool:
+        """True while the head connection may yet come back (a failover
+        reconnect is possible and not exhausted) — loops that would
+        exit on a closed conn should idle instead."""
+        return (
+            self._reconnect_enabled
+            and not self._closing
+            and not self.head_permanently_lost.is_set()
+        )
+
+    def _running(self) -> bool:
+        """Session liveness for background loops: the current conn is
+        open, or a failover may still bring a new one."""
+        if self._closing:
+            return False
+        if not self.conn.closed:
+            return True
+        return self.conn_failover_pending()
+
+    def _reconnect_loop(self) -> None:
+        from . import transport
+
+        t0 = time.monotonic()
+        if _events.enabled():
+            _events.record(
+                _events.HEAD, self.worker_id.hex()[:12], "HEAD_DOWN",
+                {"role": self.role},
+            )
+        bo = _chaos.Backoff(
+            base_s=0.2, cap_s=2.0,
+            budget_s=RayConfig.gcs_reconnect_budget_s,
+        )
+        reply = None
+        conn = None
+        while not self._closing:
+            try:
+                raw = transport.connect(self._address, self._authkey)
+            except OSError:
+                if bo.sleep():
+                    continue
+                break
+            conn = PeerConn(
+                raw, push_handler=self._on_push, name=f"client-{self.role}"
+            )
+            try:
+                reply = conn.request(
+                    self._hello_msg(reconnect=True),
+                    timeout=RayConfig.worker_register_timeout_s,
+                )
+            except (
+                ConnectionLost, TimeoutError,
+                concurrent.futures.TimeoutError, OSError,
+            ):
+                reply = None
+            if reply is None or not reply.get("ok"):
+                conn.close()
+                reply, conn = None, None
+                if bo.sleep():
+                    continue
+                break
+            break
+        ok = reply is not None and conn is not None
+        if ok:
+            self.session_dir = reply["session_dir"]
+            if reply.get("node_id"):
+                self.node_id = reply["node_id"]
+            self._conn_gen += 1
+            self.conn = conn
+            conn.set_on_close(
+                lambda gen=self._conn_gen: self._on_head_conn_close(gen)
+            )
+        with self._reconnect_lock:
+            self._reconnecting = False
+        if not ok:
+            self.head_permanently_lost.set()
+            with self._wait_cond:
+                self._wait_cond.notify_all()
+            return
+        with self._wait_cond:
+            self._head_conn_lost = False
+            self._wait_cond.notify_all()
+        try:
+            self._replay_after_reconnect(reply)
+        except Exception:  # noqa: BLE001 - replay is best-effort; the
+            pass  # recovery sweep covers what a racing close drops
+        if _events.enabled():
+            _events.record(
+                _events.HEAD, self.worker_id.hex()[:12], "HEAD_RECONNECT",
+                {
+                    "outage_s": round(time.monotonic() - t0, 3),
+                    "attempts": bo.attempts + 1,
+                    "role": self.role,
+                },
+            )
+
+    def _replay_after_reconnect(self, reply: Dict[str, Any]) -> None:
+        """Re-advertise in-flight state to the restarted head: owned
+        objects + live borrow edges (tracker reconcile), one-shot wait
+        subscriptions, and the runtime's extras (done-batch replay)."""
+        on_rec = getattr(self._tracker, "on_reconnect", None)
+        owned = on_rec() if on_rec is not None else {}
+        if owned:
+            items = []
+            for oid, borrowers in owned.items():
+                try:
+                    loc = self.store.location_of(ObjectID(oid))
+                except Exception:  # noqa: BLE001
+                    loc = None
+                items.append((oid, loc, borrowers))
+            try:
+                self.conn.send(
+                    {
+                        "type": "reconcile",
+                        "client": self.worker_id.binary(),
+                        "owned": items,
+                    }
+                )
+            except ConnectionLost:
+                pass
+        with self._wait_cond:
+            subs = list(self._wait_subscribed)
+        if subs:
+            try:
+                r = self.conn.request(
+                    {"type": "wait_subscribe", "object_ids": subs}
+                )
+                ready = r.get("ready")
+                if ready:
+                    self._wait_mark(ready, subscribed=True)
+            except (ConnectionLost, TimeoutError):
+                pass
+        cb = self.on_reconnected
+        if cb is not None:
+            try:
+                cb(reply)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _await_failover(self) -> bool:
+        """Park the calling thread until the failover lands (True) or
+        is hopeless (False). Callers re-issue their request on True."""
+        if not self.conn_failover_pending():
+            return False
+        deadline = (
+            time.monotonic()
+            + RayConfig.gcs_reconnect_budget_s
+            + RayConfig.worker_register_timeout_s
+        )
+        while time.monotonic() < deadline:
+            if self.head_permanently_lost.is_set() or self._closing:
+                return False
+            with self._wait_cond:
+                if not self._head_conn_lost and not self.conn.closed:
+                    return True
+                # Parked, not polled: the reconnect loop notifies this
+                # condvar on both success and final failure (the
+                # timeout only guards a close handler that never ran).
+                self._wait_cond.wait(timeout=0.25)
+        return False
+
+    def send_reliable(self, msg: Dict[str, Any]) -> None:
+        """A send that survives a head failover: on conn loss, park
+        until the reconnect re-registers, then resend on the new conn
+        (used for submits — the task must not be dropped because the
+        head was mid-restart)."""
+        while True:
+            try:
+                self.conn.send(msg)
+                return
+            except ConnectionLost:
+                if not self._await_failover():
+                    raise
+
+    def request_reliable(
+        self, msg: Dict[str, Any], timeout: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Request/reply across a failover: a lost connection re-issues
+        the request on the reconnected one (request ids are assigned
+        per-conn, so re-sending the same dict is safe)."""
+        while True:
+            try:
+                return self.conn.request(msg, timeout=timeout)
+            except ConnectionLost:
+                if not self._await_failover():
+                    raise
 
     def _on_push(self, msg: Dict[str, Any]):
         if type(msg) is tuple and msg[0] == "RDY":
@@ -236,6 +501,13 @@ class CoreClient:
             # At-least-once ref_flush: the head received the batch;
             # stop retransmitting it.
             ack = getattr(self._tracker, "ack", None)
+            if ack is not None:
+                ack(msg.get("seq", 0))
+            return
+        if mtype == "task_done_ack":
+            # At-least-once task_done_batch (worker runtime): the head
+            # received the completion batch; stop retransmitting it.
+            ack = self.done_ack
             if ack is not None:
                 ack(msg.get("seq", 0))
             return
@@ -336,7 +608,9 @@ class CoreClient:
             return blob
 
     def fetch_function(self, function_id: bytes) -> bytes:
-        reply = self.conn.request({"type": "get_function", "function_id": function_id})
+        reply = self.request_reliable(
+            {"type": "get_function", "function_id": function_id}
+        )
         if not reply.get("ok"):
             raise RayTpuError(f"function {function_id.hex()} not found in GCS")
         return reply["blob"]
@@ -354,7 +628,9 @@ class CoreClient:
                 _events.TASK, spec.task_id.hex(), "SUBMITTED",
                 {"route": "gcs", "name": spec.name},
             )
-        self.conn.send({"type": "submit_task", "spec": spec})
+        # Reliable: a submit racing a head restart parks on the
+        # failover and lands on the recovered head instead of vanishing.
+        self.send_reliable({"type": "submit_task", "spec": spec})
         owner = self.worker_id.binary()
         refs = [ObjectRef(oid, owner) for oid in spec.return_object_ids()]
         self._advertise_returns(refs)
@@ -719,7 +995,7 @@ class CoreClient:
             self._lease_reaper.start()
 
     def _lease_reaper_loop(self):
-        while not self.conn.closed:
+        while self._running():
             time.sleep(0.1)
             now = time.monotonic()
             to_return = []
@@ -737,7 +1013,7 @@ class CoreClient:
                             to_return.append(lease)
             for lease in to_return:
                 lease["conn"].close()
-                if self.conn.closed:
+                if not self._running():
                     return
                 self._send_lease_return(
                     lease["worker_id"], lease.get("raylet", False)
@@ -965,7 +1241,7 @@ class CoreClient:
             # Refs nested inside the stored value: the directory pins them
             # while this object lives (borrowing — reference_count.h:61).
             fields["children"] = cap.seen
-        reply = self.conn.request({"type": "put_object", **fields})
+        reply = self.request_reliable({"type": "put_object", **fields})
         if not reply.get("ok"):
             raise RayTpuError(f"put failed: {reply}")
         return fields
@@ -1029,7 +1305,7 @@ class CoreClient:
                 # The copy may have moved while this reply was in
                 # flight (spilled to disk between directory lookup and
                 # our read): ask the directory again once.
-                fresh = self.conn.request(
+                fresh = self.request_reliable(
                     {"type": "get_object", "object_id": oid.binary()}
                 )
                 return self._materialize(fresh, oid, _retried=True,
@@ -1057,8 +1333,8 @@ class CoreClient:
                 spec = self._lineage.get(oid.binary())
                 if spec is None:
                     raise
-                self.conn.send({"type": "submit_task", "spec": spec})
-                reply = self.conn.request(
+                self.send_reliable({"type": "submit_task", "spec": spec})
+                reply = self.request_reliable(
                     {"type": "get_object", "object_id": oid.binary()},
                     timeout=remaining,
                 )
@@ -1113,6 +1389,40 @@ class CoreClient:
             raise exc
         return entry
 
+    def _gcs_get_fields(
+        self, ref: ObjectRef, fut, deadline: Optional[float]
+    ) -> Dict[str, Any]:
+        """Resolve one GCS-routed get_object, riding out a head
+        failover: a request parked on a connection that dies re-issues
+        on the reconnected head (which re-parks it as a waiter; the
+        recovery sweep answers LOST for entries nobody reclaims, so the
+        get resolves into lineage reconstruction instead of wedging)."""
+        while True:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(f"get timed out on {ref}")
+            if fut is not None:
+                try:
+                    return fut.result(timeout=remaining)
+                except (TimeoutError, concurrent.futures.TimeoutError):
+                    # Both: only Python 3.11 unified futures.TimeoutError
+                    # with the builtin.
+                    raise GetTimeoutError(
+                        f"get timed out on {ref}"
+                    ) from None
+                except ConnectionLost:
+                    fut = None  # fall through to the failover retry
+            if not self._await_failover():
+                raise ConnectionLost("GCS connection lost during get")
+            try:
+                fut = self.conn.request_async(
+                    {"type": "get_object", "object_id": ref.id().binary()}
+                )
+            except ConnectionLost:
+                fut = None  # reconnected conn died again: loop
+
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None,
             packed: bool = False) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -1128,15 +1438,15 @@ class CoreClient:
                 # no GCS round-trip.
                 futs.append((ref, entry, True))
             else:
-                futs.append(
-                    (
-                        ref,
-                        self.conn.request_async(
-                            {"type": "get_object", "object_id": ref.id().binary()}
-                        ),
-                        False,
+                try:
+                    fut = self.conn.request_async(
+                        {"type": "get_object", "object_id": ref.id().binary()}
                     )
-                )
+                except ConnectionLost:
+                    # Head mid-restart: the collection loop re-issues
+                    # this one after the failover lands.
+                    fut = None
+                futs.append((ref, fut, False))
         out = []
         for ref, ent, direct in futs:
             remaining = None
@@ -1147,12 +1457,7 @@ class CoreClient:
             if direct:
                 fields = self._resolve_direct_entry(ref, ent, remaining)
             else:
-                try:
-                    fields = ent.result(timeout=remaining)
-                except (TimeoutError, concurrent.futures.TimeoutError):
-                    # Both: only Python 3.11 unified futures.TimeoutError
-                    # with the builtin.
-                    raise GetTimeoutError(f"get timed out on {ref}") from None
+                fields = self._gcs_get_fields(ref, ent, deadline)
             if direct and (
                 fields.get("via_gcs")
                 or (
@@ -1164,7 +1469,7 @@ class CoreClient:
                 # Resubmitted via the GCS, or a large result not in the
                 # local store: the directory has (or will have) the
                 # authoritative location.
-                fields = self.conn.request(
+                fields = self.request_reliable(
                     {"type": "get_object", "object_id": ref.id().binary()},
                     timeout=remaining,
                 )
@@ -1242,7 +1547,7 @@ class CoreClient:
             # Synchronous: the old check_ready always performed one
             # readiness round-trip even with timeout=0 — "check once"
             # callers must see objects already sealed at the GCS.
-            reply = self.conn.request(
+            reply = self.request_reliable(
                 {"type": "wait_subscribe", "object_ids": to_subscribe}
             )
             already = reply.get("ready")
@@ -1250,7 +1555,11 @@ class CoreClient:
                 self._wait_mark(already, subscribed=True)
         while True:
             with cond:
-                if self._head_conn_lost:
+                if self._head_conn_lost and not self.conn_failover_pending():
+                    # Head gone for good. While a failover reconnect is
+                    # still possible the wait parks instead: the replay
+                    # re-subscribes every id and the condvar is notified
+                    # on both reconnect success and final failure.
                     raise ConnectionLost("GCS connection lost during wait")
                 if num_returns == 1:
                     # Drain-loop fast path: results complete roughly in
@@ -1317,7 +1626,7 @@ class CoreClient:
         # Explicit free: drop tracker state so the instances still alive
         # can't emit retractions for entries already gone.
         self._tracker.forget(ids)
-        self.conn.send({"type": "free_objects", "object_ids": ids})
+        self.send_reliable({"type": "free_objects", "object_ids": ids})
         # Drop our local copies (pulled replicas / remote-driver puts);
         # the GCS fan-out only reaches node daemons, not this process.
         for r in refs:
@@ -1352,7 +1661,11 @@ class CoreClient:
         return self.conn.request({"type": "cluster_info"})
 
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Dict[str, Any]:
-        return self.conn.request(msg, timeout=timeout)
+        # Failover-transparent: control-plane requests (kv, actor
+        # lookups, cluster info, state reads) park across a head
+        # restart and re-issue, instead of surfacing ConnectionLost to
+        # every API caller mid-failover.
+        return self.request_reliable(msg, timeout=timeout)
 
     def flush_runtime_events(self) -> None:
         """Ship this process's flight-recorder ring to the head.
@@ -1389,6 +1702,12 @@ class CoreClient:
         self.conn.send(msg)
 
     def close(self):
+        # Mark the session over BEFORE closing the conn: the close
+        # handler must not launch a reconnect against a head we are
+        # deliberately leaving, and watchers parked on
+        # head_permanently_lost must exit now.
+        self._closing = True
+        self.head_permanently_lost.set()
         self.conn.close()
         rp = getattr(self, "_raylet_peer", None)
         if rp is not None:

@@ -63,6 +63,26 @@ _DEFAULTS: Dict[str, Any] = {
     # env var.
     "chaos_spec": "",
     "chaos_seed": 0,
+    # Head failover (reference: gcs_rpc_client reconnect-with-backoff +
+    # NotifyGCSRestart re-reporting). How long a client/worker keeps
+    # retrying the head address after its control connection drops
+    # before declaring the session dead. Raylets use
+    # worker_register_timeout_s for the same budget (pre-existing).
+    "gcs_reconnect_budget_s": 15.0,
+    # How long a PENDING directory entry that exists ONLY because a
+    # get/wait asked about an unknown object id may stay unclaimed (no
+    # owner, no pins, no seal) before the head answers LOST. Normal
+    # operation claims such entries within milliseconds (the submit or
+    # done batch that races the get); one that never gains substance is
+    # a producer lost in a head failover — LOST routes the parked
+    # caller into lineage reconstruction instead of a wedged get.
+    "pending_ghost_grace_s": 20.0,
+    # Recovery grace window opened by a restarted head: reconnecting
+    # owners re-advertise owned objects/borrow edges, workers re-claim
+    # their actors and running tasks, and unacked done batches replay.
+    # At window close, unclaimed soft state is swept through the
+    # owner-death/lineage path (orphans reconstruct, they don't leak).
+    "head_recovery_grace_s": 3.0,
     # How long a dead owner's promoted directory entries are held
     # before they become reclaimable: borrow edges buffered in the
     # borrower's unflushed ref_flush batch (or an in-flight retransmit)

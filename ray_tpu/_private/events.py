@@ -48,9 +48,9 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 # Categories.
-TASK, WORKER, LEASE, OBJECT, TRANSFER, SCHED, REFS, CHAOS = (
+TASK, WORKER, LEASE, OBJECT, TRANSFER, SCHED, REFS, CHAOS, HEAD = (
     "task", "worker", "lease", "object", "transfer", "sched", "refs",
-    "chaos",
+    "chaos", "head",
 )
 
 #: Order of the canonical per-task transitions; also the stitch order.

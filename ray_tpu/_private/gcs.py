@@ -441,6 +441,42 @@ class GcsServer:
         # cross-table cut (table files are versioned, never rewritten
         # in place).
         self._manifest: Dict[str, str] = {}
+        # Head-failover recovery window (reference: NotifyGCSRestart —
+        # bearers of truth re-report after a GCS restart). While
+        # monotonic() < _recovering_until, reconnecting owners
+        # re-advertise owned objects/borrow edges (_h_reconcile),
+        # workers re-claim their hosted actors and running tasks
+        # (_h_hello reconnect), and unacked done batches replay.
+        # _finish_recovery sweeps whatever nobody reclaimed through
+        # the owner-death/lineage path.
+        self._recovering_until = 0.0
+        #: Dispatched-but-unfinished specs restored from the snapshot,
+        #: parked here until a surviving worker claims them or the
+        #: window closes (then they re-queue and re-execute).
+        self._recover_inflight: Dict[bytes, TaskSpec] = {}
+        #: Actor ids restored A_RESTARTING whose hosting worker may
+        #: still be alive; claimed via hello reconnect, else restarted
+        #: (or declared dead) at window close.
+        self._recover_actors: Set[bytes] = set()
+        #: Object ids restored from the snapshot, awaiting an owner
+        #: re-claim; unclaimed ones free at window close (no leak).
+        self._restored_unclaimed: Set[bytes] = set()
+        #: Return oids workers reported as mid-execution at reconnect.
+        #: Leased/direct-dispatched tasks have NO head-side spec, so
+        #: without this their in-flight returns would read as
+        #: producer-less to the lost-producer sweeps and go LOST while
+        #: the task still runs. Bounded by executing-at-reconcile size.
+        self._reconcile_expected: Set[bytes] = set()
+        #: (deadline, oid) for PENDING entries conjured by a question
+        #: (get/wait on an unknown id) or an owner re-claim without a
+        #: local copy — in a session that went through a head restart.
+        #: If no known producer exists when the deadline passes, the
+        #: health loop answers LOST so the parked get resolves into
+        #: lineage reconstruction instead of wedging on a submit that
+        #: died with the old head. Never armed in sessions that never
+        #: restored (no behavior change for healthy heads).
+        self._ghost_watch: deque = deque()
+        self._restored_session = False
         try:
             restored_legacy = self._restore_state()
             if restored_legacy:
@@ -451,6 +487,21 @@ class GcsServer:
                 self._version += 1
                 for t in self._TABLES:
                     self._table_versions[t] += 1
+            # Restored from a previous head's snapshot: open the
+            # recovery grace window for reconnecting bearers of truth.
+            self._restored_session = True
+            self._recovering_until = (
+                time.monotonic() + RayConfig.head_recovery_grace_s
+            )
+            _events.record(
+                _events.HEAD, "gcs", "RECONCILE_BEGIN",
+                {
+                    "grace_s": RayConfig.head_recovery_grace_s,
+                    "actors": len(self._recover_actors),
+                    "inflight": len(self._recover_inflight),
+                    "objects": len(self._restored_unclaimed),
+                },
+            )
         except FileNotFoundError:
             pass
         except Exception as e:  # noqa: BLE001 - corrupt snapshot
@@ -614,6 +665,10 @@ class GcsServer:
     def _dispatch(self, state: Dict[str, Any], msg: Dict[str, Any]):
         mtype = msg["type"]
         self.msg_counts[mtype] = self.msg_counts.get(mtype, 0) + 1
+        # Chaos: head death at the dispatch boundary — a message was
+        # received (possibly acked by transport) but its handler never
+        # ran; every client-side at-least-once path must absorb it.
+        _chaos.kill_point("gcs.dispatch")
         # Fault injection (including the legacy testing_rpc_delay_us
         # delays) happens at the transport boundary now — PeerConn's
         # deliver side runs the chaos schedule before dispatch.
@@ -660,6 +715,7 @@ class GcsServer:
         role = msg["role"]
         state["role"] = role
         node_id = self.head_node.node_id.binary()
+        reply_extra: Dict[str, Any] = {}
         if role == "worker":
             wid = msg["worker_id"]
             state["worker_id"] = wid
@@ -673,7 +729,23 @@ class GcsServer:
                     hello_nid = msg.get("node_id")
                     node = (
                         self.nodes.get(hello_nid) if hello_nid else None
-                    ) or self.head_node
+                    )
+                    if node is None and hello_nid and msg.get("reconnect"):
+                        # Failover: this worker outlived the old head
+                        # and reconnected BEFORE its raylet re-registered
+                        # the node. A placeholder keeps its object
+                        # locations bound to the right node id; the
+                        # raylet's register_node replaces it (same key)
+                        # with the real NodeState moments later.
+                        node = NodeState(
+                            node_id=NodeID(hello_nid),
+                            total={},
+                            available={},
+                            label="rejoining",
+                            schedulable=False,
+                        )
+                        self.nodes[hello_nid] = node
+                    node = node or self.head_node
                     w = WorkerHandle(
                         worker_id=WorkerID(wid), node_id=node.node_id
                     )
@@ -694,9 +766,11 @@ class GcsServer:
                     w.state = W_IDLE
                     node.pool.add(wid)
                 node_id = node.node_id.binary()
+                if msg.get("reconnect"):
+                    reply_extra = self._reconcile_worker(w, node, msg)
                 _events.record(
                     _events.WORKER, w.worker_id.hex(), "REGISTERED",
-                    {"pid": w.pid},
+                    {"pid": w.pid, "reconnect": bool(msg.get("reconnect"))},
                 )
                 self._work.notify_all()
         elif role == "driver" and msg.get("transfer_addr"):
@@ -724,8 +798,115 @@ class GcsServer:
             # Borrow-update relays resolve owners through this map.
             self.client_conns[msg["worker_id"]] = peer
         peer.reply(
-            msg, ok=True, session_dir=self.session_dir, node_id=node_id
+            msg, ok=True, session_dir=self.session_dir, node_id=node_id,
+            **reply_extra,
         )
+
+    def _reconcile_worker(self, w: WorkerHandle, node: NodeState,
+                          msg: Dict[str, Any]) -> Dict[str, Any]:
+        """A worker that outlived the old head re-registered: re-bind
+        what it authoritatively hosts (reference: bearers of truth
+        re-report after NotifyGCSRestart). Caller holds the lock.
+
+        - hosted actors re-bind to this worker instead of being
+          recreated at window close (state survives the failover);
+        - tasks mid-execution move back into the inflight table so
+          their completion (and death) accounting works;
+        - sealed store-backed results it still holds rebuild their
+          directory locations.
+
+        Returns reply extras; ``drop_actors`` names instances the head
+        refused to re-bind (unknown, dead, or already recreated) so the
+        worker can discard them."""
+        wid = w.worker_id.binary()
+        drop: List[bytes] = []
+        hosted = list(msg.get("actors", ()) or ())
+        shared = bool(msg.get("shared_host")) or len(hosted) > 1
+        claimed_actors = 0
+        for aid in hosted:
+            actor = self.actors.get(aid)
+            if (
+                actor is None
+                or actor.state == A_DEAD
+                or aid not in self._recover_actors
+            ):
+                # Unknown, dead, or already recreated elsewhere (the
+                # recovery window closed without this claim): the
+                # worker must drop its orphan instance.
+                drop.append(aid)
+                continue
+            self._recover_actors.discard(aid)
+            actor.state = A_ALIVE
+            actor.worker_id = w.worker_id
+            if shared:
+                w.actor_host = True
+                w.packed[aid] = actor.spec
+                node.actor_hosts.add(wid)
+            else:
+                w.actor_id = actor.actor_id
+                w.state = W_ACTOR
+            node.pool.discard(wid)
+            # Re-acquire the creation-lifetime resources on the fresh
+            # node view (best-effort: PG bundles re-reserve on their
+            # own path).
+            if actor.spec.placement_group_id is None:
+                _acquire(node.available, self._task_resources(actor.spec))
+            while actor.pending:
+                self._route_actor_task(actor.pending.popleft())
+            self._notify_direct_waiters(actor)
+            self._publish("ACTOR", aid.hex(), {"state": "ALIVE"})
+            claimed_actors += 1
+        claimed_tasks = 0
+        for ent in msg.get("executing", ()) or ():
+            if isinstance(ent, (tuple, list)):
+                tid, roids = ent[0], ent[1]
+            else:  # bare task id (older worker)
+                tid, roids = ent, ()
+            # Reported returns are expected regardless of whether the
+            # head knows the spec: leased/direct tasks are dispatched
+            # worker-to-worker and must not have their in-flight
+            # returns swept LOST.
+            self._reconcile_expected.update(roids)
+            spec = self._recover_inflight.pop(tid, None)
+            if spec is None:
+                continue
+            w.inflight[tid] = spec
+            if spec.actor_id is None and not spec.actor_creation:
+                if w.state == W_IDLE:
+                    w.state = W_BUSY
+                    w.current_task = spec
+                    w.task_started_at = time.time()
+                if spec.placement_group_id is None:
+                    _acquire(node.available, self._task_resources(spec))
+            claimed_tasks += 1
+        claimed_objects = 0
+        for oid, loc in msg.get("sealed", ()) or ():
+            entry = self.objects.setdefault(oid, ObjectEntry())
+            if entry.status == PENDING and loc:
+                entry.status = READY
+                entry.segment = loc
+                entry.node_id = node.node_id
+                entry.last_access = time.time()
+                self._notify_object(entry)
+                claimed_objects += 1
+                if entry.owner is None and not entry.holders:
+                    # Location known but nobody claims ownership (yet):
+                    # the owner's reconcile or the window-close sweep
+                    # decides its fate — never a silent leak.
+                    self._restored_unclaimed.add(oid)
+        if _events.enabled() and (
+            claimed_actors or claimed_tasks or claimed_objects or drop
+        ):
+            _events.record(
+                _events.HEAD, w.worker_id.hex()[:12], "RECONCILE_CLAIM",
+                {
+                    "actors": claimed_actors,
+                    "tasks": claimed_tasks,
+                    "sealed": claimed_objects,
+                    "dropped": len(drop),
+                },
+            )
+        return {"drop_actors": drop} if drop else {}
 
     def _h_register_function(self, state, msg):
         with self._lock:
@@ -944,7 +1125,35 @@ class GcsServer:
         """Coalesced direct-path completions (one message per worker per
         flush interval instead of one per call — the GCS lives in the
         driver process, so per-call handling steals driver GIL time at
-        the aggregate cluster call rate)."""
+        the aggregate cluster call rate).
+
+        Sequenced at-least-once (mirror of ref_flush): the worker's
+        batcher numbers every item-carrying batch and retransmits until
+        acked — completions are the bearer-of-truth record a head crash
+        must not lose — and a per-conn sequencer dedups/reorders here
+        so re-deliveries apply once, in submission order. Un-numbered
+        batches (old peers, pure event piggybacks) apply directly."""
+        seq = msg.get("seq")
+        if seq is not None and msg.get("items"):
+            try:
+                state["peer"].send({"type": "task_done_ack", "seq": seq})
+            except ConnectionLost:
+                pass
+            seqr = state.get("done_seq")
+            if seqr is None:
+                # start_seq=1: the batcher numbers from 1 per
+                # connection; a dropped FIRST batch must read as a gap,
+                # never as an already-applied duplicate.
+                seqr = state["done_seq"] = _chaos.InOrderSequencer(
+                    start_seq=1
+                )
+            batches = seqr.offer(seq, msg)
+        else:
+            batches = [msg]
+        for m in batches:
+            self._apply_task_done_batch(m)
+
+    def _apply_task_done_batch(self, msg):
         wid = msg["worker_id"]
         freed: List[bytes] = []
         borrow_notify: List[Tuple[bytes, bytes, bytes]] = []
@@ -999,6 +1208,14 @@ class GcsServer:
         w = self.workers.get(wid)
         task_id = msg["task_id"]
         spec: Optional[TaskSpec] = w.inflight.pop(task_id, None) if w else None
+        if self._recover_inflight:
+            # A completion IS the strongest re-claim: the task must not
+            # be re-queued at recovery-window close (it already ran —
+            # possibly finishing during the head outage, with this
+            # batch retransmitted to the restarted head).
+            rec_spec = self._recover_inflight.pop(task_id, None)
+            if spec is None:
+                spec = rec_spec
         self.task_events.append(
             (
                 task_id,
@@ -1268,6 +1485,11 @@ class GcsServer:
                 entry = self.objects.setdefault(
                     msg["object_id"], ObjectEntry()
                 )
+                # Born from a question, not a fact: if no producer or
+                # owner ever claims it, it goes LOST after a grace
+                # (the parked get must not wedge on a submit that died
+                # with a previous head).
+                self._note_ghost(msg["object_id"])
             if entry.status == PENDING:
                 entry.waiters.append((peer, msg["req_id"]))
                 return
@@ -1292,7 +1514,10 @@ class GcsServer:
         with self._lock:
             ready = []
             for oid in msg["object_ids"]:
-                entry = self.objects.setdefault(oid, ObjectEntry())
+                entry = self.objects.get(oid)
+                if entry is None:
+                    entry = self.objects.setdefault(oid, ObjectEntry())
+                    self._note_ghost(oid)  # see _h_get_object
                 if entry.status != PENDING:
                     ready.append(oid)
                 else:
@@ -1304,7 +1529,10 @@ class GcsServer:
         peer: PeerConn = state["peer"]
         with self._lock:
             for oid in msg["object_ids"]:
-                entry = self.objects.setdefault(oid, ObjectEntry())
+                entry = self.objects.get(oid)
+                if entry is None:
+                    entry = self.objects.setdefault(oid, ObjectEntry())
+                    self._note_ghost(oid)  # see _h_get_object
                 if entry.status != PENDING:
                     peer.reply(msg, ok=True)
                     return
@@ -1613,6 +1841,58 @@ class GcsServer:
                 {"promoted": promoted, "freed": len(freed)},
             )
         self._broadcast_free(freed)
+
+    def _h_reconcile(self, state, msg):
+        """A reconnecting owner re-advertises the objects it OWNS plus
+        their live borrow edges (head failover: the restarted head's
+        object soft state is rebuilt from bearers of truth, not
+        persisted). Each item is (oid, location-or-None, [borrowers]);
+        a location means the owner's local store still holds the sealed
+        bytes, so the entry can answer gets immediately."""
+        _chaos.kill_point("gcs.recovery")
+        cid = msg["client"]
+        claimed = 0
+        borrow_ops: List[tuple] = []
+        with self._lock:
+            nid = state.get("obj_node_id")
+            node_id = NodeID(nid) if nid else self.head_node.node_id
+            for oid, loc, borrowers in msg.get("owned", ()):
+                entry = self.objects.setdefault(oid, ObjectEntry())
+                if entry.owner is None:
+                    entry.owner = cid
+                if entry.owner == cid:
+                    # The owner lives: whatever promoted/released state
+                    # a racing sweep left behind is superseded.
+                    entry.owner_released = False
+                    entry.promoted_hold_until = 0.0
+                entry.had_holder = True
+                for b in borrowers:
+                    if not self.objects.is_dead_client(b):
+                        # Holder shadows apply on the shard appliers
+                        # (never on this dispatch thread).
+                        borrow_ops.append(("badd", oid, b))
+                if loc and entry.status == PENDING:
+                    entry.status = READY
+                    entry.segment = loc
+                    entry.node_id = node_id
+                    entry.last_access = time.time()
+                    self._notify_object(entry)
+                elif entry.status == PENDING:
+                    # Claimed but data-less (a return ref whose result
+                    # lives elsewhere): if no producer re-claims it
+                    # either, it must expire to LOST, not wedge gets.
+                    self._note_ghost(oid)
+                self._restored_unclaimed.discard(oid)
+                claimed += 1
+        if borrow_ops:
+            self.objects.enqueue(borrow_ops)
+        if _events.enabled() and claimed:
+            _events.record(
+                _events.HEAD, cid.hex()[:12], "RECONCILE_CLAIM",
+                {"owned": claimed, "borrow_edges": len(borrow_ops)},
+            )
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True)
 
     def _h_free_objects(self, state, msg):
         freed: List[bytes] = []
@@ -2355,6 +2635,19 @@ class GcsServer:
                 transfer_addr=msg.get("transfer_addr", ""),
                 last_heartbeat=time.time(),
             )
+            prev = self.nodes.get(node.node_id.binary()) if nid else None
+            if prev is not None:
+                # Workers of this node that reconnected BEFORE their
+                # daemon (head failover) registered pool membership and
+                # re-acquired actor/task resources on a zero-capacity
+                # placeholder — carry both over, or the claimed work
+                # becomes invisible/oversubscribed (the heartbeat sync
+                # only adjusts local-lease deltas, never this).
+                node.pool = prev.pool
+                node.actor_hosts = prev.actor_hosts
+                for k, v in prev.available.items():
+                    if v < 0:  # acquired against the empty placeholder
+                        node.available[k] = node.available.get(k, 0.0) + v
             self.nodes[node.node_id.binary()] = node
             self._daemon_conn_count += 1
             state["role"] = "raylet"
@@ -2493,7 +2786,23 @@ class GcsServer:
                 for aid, a in self.actors.items()
             }
         if table == "pending":
-            return list(self._pending)
+            # Dispatched-but-unfinished specs persist alongside the
+            # queue: a head crash must not lose in-flight tasks (they
+            # park in the recovery window for their worker to re-claim;
+            # unclaimed ones re-queue and re-execute — at-least-once,
+            # like lineage reconstruction). Actor methods ride too;
+            # creations are governed by the actors table.
+            return {
+                "queued": list(self._pending),
+                "inflight": [
+                    spec
+                    for w in self.workers.values()
+                    if w.state != W_DEAD
+                    for spec in w.inflight.values()
+                    if not spec.actor_creation
+                ]
+                + list(self._recover_inflight.values()),
+            }
         if table == "orphans":
             return {
                 aid: list(specs)
@@ -2556,6 +2865,13 @@ class GcsServer:
                         f.write(_pickle.dumps(payload))
                     os.replace(tmp, os.path.join(self._state_dir, name))
                     self._manifest[t] = name
+                # Chaos: crash-consistency point — new table files are
+                # on disk but the manifest still names the previous
+                # generation. A kill here must leave a restart loading
+                # the last COMPLETE cut (the .tmp + rename ordering is
+                # what this kill point exists to prove).
+                if snaps:
+                    _chaos.kill_point("gcs.mid_persist")
                 mtmp = os.path.join(self._state_dir, "manifest.pkl.tmp")
                 with open(mtmp, "wb") as f:
                     f.write(_pickle.dumps(dict(self._manifest)))
@@ -2625,8 +2941,23 @@ class GcsServer:
                 # the node binding to route through the transfer plane.
                 e.node_id = self.head_node.node_id
             self.objects[oid] = e
-        for spec in snap["pending"]:
+            # Awaiting an owner's reconcile re-claim; swept (freed)
+            # at recovery-window close if nobody claims it.
+            self._restored_unclaimed.add(oid)
+        pend = snap["pending"]
+        if isinstance(pend, dict):
+            queued, inflight = pend["queued"], pend["inflight"]
+        else:  # legacy list-only snapshot
+            queued, inflight = pend, []
+        for spec in queued:
             self._pending.append(spec)
+        for spec in inflight:
+            if spec.actor_creation:
+                continue  # the actors table governs creations
+            # Parked for the recovery window: a surviving worker
+            # re-claims it (hello reconnect "executing"), else it
+            # re-queues at window close and re-executes.
+            self._recover_inflight[spec.task_id.binary()] = spec
         for aid, specs in snap["orphans"].items():
             self._orphan_actor_tasks[aid] = list(specs)
         for pid, rec in snap.get("placement_groups", {}).items():
@@ -2650,29 +2981,36 @@ class GcsServer:
                 restarts_used=rec["restarts_used"],
             )
             spec: TaskSpec = rec["spec"]
-            detached = spec.lifetime == "detached"
             was_scheduled = rec["state"] not in (A_PENDING,)
             if rec["state"] == A_DEAD:
                 actor.state = A_DEAD
                 actor.death_reason = rec["death_reason"]
-            elif (
-                was_scheduled
-                and not detached
-                and actor.restarts_used >= spec.max_restarts
-            ):
-                # The worker died with the old head; recreating would
-                # break at-most-once semantics for non-restartable,
-                # non-detached actors (same limit _handle_worker_death
-                # enforces).
-                actor.state = A_DEAD
-                actor.death_reason = (
-                    "actor lost in head failover (max_restarts exhausted)"
-                )
-                if actor.name:
-                    self.named_actors.pop(actor.name, None)
+            elif was_scheduled:
+                # Live failover: the hosting worker may have OUTLIVED
+                # the head and will re-claim this actor during the
+                # recovery grace window (hello reconnect) — state
+                # intact, no restart consumed. Only at window close
+                # does an unclaimed actor restart from its creation
+                # spec (or die when its budget is spent);
+                # _finish_recovery applies the same at-most-once limit
+                # _handle_worker_death enforces.
+                actor.state = A_RESTARTING
+                for m in rec["pending"]:
+                    actor.pending.append(m)
+                if not any(
+                    s.actor_creation
+                    and s.actor_id is not None
+                    and s.actor_id.binary() == aid
+                    for s in self._pending
+                ):
+                    self._recover_actors.add(aid)
+                # else: the OLD head had already re-queued this actor's
+                # creation (its worker died pre-crash) and the queued
+                # spec was restored with the pending table — recreating
+                # via that spec is the only correct path (no live
+                # worker can claim it, and offering a claim AND keeping
+                # the queued spec would create the actor twice).
             else:
-                if was_scheduled and not detached:
-                    actor.restarts_used += 1
                 actor.state = A_PENDING
                 for m in rec["pending"]:
                     actor.pending.append(m)
@@ -3113,7 +3451,92 @@ class GcsServer:
                 self._handle_node_death(
                     nid, "node heartbeat timed out (unreachable or hung)"
                 )
+            if (
+                self._recovering_until
+                and time.monotonic() >= self._recovering_until
+            ):
+                self._finish_recovery()
+            self._drain_ghosts()
             self._drain_promoted_graves()
+
+    def _note_ghost(self, oid: bytes) -> None:
+        """Caller holds the lock: watch an entry created by a question
+        (get/wait on an unknown id) — see _ghost_watch. Armed only in
+        sessions that restored from a snapshot."""
+        if self._restored_session:
+            self._ghost_watch.append(
+                (time.monotonic() + RayConfig.pending_ghost_grace_s, oid)
+            )
+
+    def _expected_return_oids(self) -> Set[bytes]:
+        """Return oids some known producer will still seal: queued,
+        dispatched (inflight), recovery-parked, and actor-buffered
+        specs. Caller holds the lock. PENDING entries outside this set
+        will never seal."""
+        expected: Set[bytes] = set()
+
+        def _expect(s: TaskSpec) -> None:
+            for o in s.return_object_ids():
+                expected.add(o.binary())
+
+        for spec in self._pending:
+            _expect(spec)
+        for spec in self._recover_inflight.values():
+            _expect(spec)
+        for w in self.workers.values():
+            for s in w.inflight.values():
+                _expect(s)
+        for a in self.actors.values():
+            for s in a.pending:
+                _expect(s)
+        expected |= self._reconcile_expected
+        return expected
+
+    def _drain_ghosts(self) -> None:
+        """Ghost expiry: a PENDING entry whose producing task is not in
+        any queue a full grace after a get/wait conjured it (or an
+        owner re-claimed it without a local copy) will never seal — the
+        submit died with a previous head. Answer LOST so parked gets
+        resolve into lineage reconstruction. Ownership alone is NOT
+        protection: a reconnecting owner's reconcile claims its return
+        refs whether or not their producer survived."""
+        mono = time.monotonic()
+        due: List[bytes] = []
+        while self._ghost_watch and self._ghost_watch[0][0] <= mono:
+            due.append(self._ghost_watch.popleft()[1])
+        if not due:
+            return
+        freed: List[bytes] = []
+        lost = 0
+        with self._lock:
+            expected = None
+            for oid in due:
+                entry = self.objects.get(oid)
+                if (
+                    entry is None
+                    or entry.status != PENDING
+                    or entry.task_pins > 0
+                    or entry.child_pins > 0
+                ):
+                    continue
+                if expected is None:
+                    # Lazily: due ghosts are rare (failover aftermath).
+                    expected = self._expected_return_oids()
+                if oid in expected:
+                    continue
+                entry.status = LOST
+                self._notify_object(entry)
+                entry.had_holder = True
+                self._maybe_free(oid, entry, freed)
+                lost += 1
+            if lost:
+                self._version += 1
+                self._table_versions["objects"] += 1
+        if lost and _events.enabled():
+            _events.record(
+                _events.HEAD, "gcs", "GHOSTS_LOST", {"n": lost}
+            )
+        self._broadcast_free(freed)
 
     def _drain_promoted_graves(self) -> None:
         """Owner-death grace expiry: re-run the free check for promoted
@@ -3148,6 +3571,119 @@ class GcsServer:
             if freed:
                 self._version += 1
                 self._table_versions["objects"] += 1
+        self._broadcast_free(freed)
+
+    def _finish_recovery(self) -> None:
+        """Recovery-window close: whatever no bearer of truth
+        re-claimed is swept through the existing owner-death/lineage
+        machinery — unclaimed actors restart from their creation specs
+        (or die when their budget is spent), unclaimed in-flight tasks
+        re-queue and re-execute, unclaimed restored objects free, and
+        PENDING entries nothing will ever seal go LOST so parked gets
+        resolve into lineage reconstruction instead of wedging."""
+        _chaos.kill_point("gcs.recovery")
+        freed: List[bytes] = []
+        stats = {"actors_restarted": 0, "actors_dead": 0,
+                 "tasks_requeued": 0, "objects_swept": 0, "lost": 0}
+        with self._lock:
+            if not self._recovering_until:
+                return
+            self._recovering_until = 0.0
+            # 1. Unclaimed actors: the old worker never came back.
+            for aid in list(self._recover_actors):
+                actor = self.actors.get(aid)
+                if actor is None or actor.state != A_RESTARTING:
+                    continue
+                spec = actor.spec
+                detached = spec.lifetime == "detached"
+                if not detached and actor.restarts_used >= spec.max_restarts:
+                    # At-most-once for non-restartable, non-detached
+                    # actors (same limit _handle_worker_death enforces).
+                    actor.state = A_DEAD
+                    actor.death_reason = (
+                        "actor lost in head failover "
+                        "(max_restarts exhausted)"
+                    )
+                    if actor.name:
+                        self.named_actors.pop(actor.name, None)
+                    while actor.pending:
+                        self._fail_task_returns(
+                            actor.pending.popleft(), None,
+                            actor_error=actor.death_reason,
+                        )
+                    self._notify_direct_waiters(actor)
+                    self._publish(
+                        "ACTOR", aid.hex(),
+                        {"state": "DEAD", "reason": actor.death_reason},
+                    )
+                    stats["actors_dead"] += 1
+                else:
+                    if not detached:
+                        actor.restarts_used += 1
+                    actor.worker_id = None
+                    if not any(
+                        s.actor_creation
+                        and s.actor_id is not None
+                        and s.actor_id.binary() == aid
+                        for s in self._pending
+                    ):
+                        self._pending.append(spec)
+                    stats["actors_restarted"] += 1
+            self._recover_actors.clear()
+            # 2. Unclaimed in-flight tasks: their workers died with the
+            # old head — re-queue (at-least-once, like reconstruction).
+            for spec in self._recover_inflight.values():
+                if spec.actor_id is not None and not spec.actor_creation:
+                    self._route_actor_task(spec)
+                else:
+                    self._pending.append(spec)
+                stats["tasks_requeued"] += 1
+            self._recover_inflight.clear()
+            # 3. Return oids a queued/claimed/restarting producer will
+            # still seal — these stay PENDING legitimately.
+            expected = self._expected_return_oids()
+            # 4. Restored objects nobody re-claimed: free through the
+            # ownerless path (no leak; a late owner claim would have
+            # removed them from this set).
+            for oid in self._restored_unclaimed:
+                e = self.objects.get(oid)
+                if e is None or e.owner is not None or oid in expected:
+                    continue
+                e.had_holder = True
+                n0 = len(freed)
+                self._maybe_free(oid, e, freed)
+                stats["objects_swept"] += len(freed) - n0
+            self._restored_unclaimed.clear()
+            # 5. PENDING ghosts: entries with no producer left in any
+            # queue — the submit died with the old head and every
+            # bearer has now reported. Answer LOST; owners reconstruct
+            # from lineage instead of wedging forever. (Ownership is
+            # NOT protection: a reconnecting owner re-claims its
+            # return refs whether or not their producer survived.)
+            for oid, e in self.objects.items():
+                if (
+                    e.status == PENDING
+                    and e.task_pins == 0
+                    and oid not in expected
+                ):
+                    e.status = LOST
+                    self._notify_object(e)
+                    e.had_holder = True
+                    self._maybe_free(oid, e, freed)
+                    stats["lost"] += 1
+            self._version += 1
+            for _t in ("objects", "actors", "pending", "named_actors"):
+                self._table_versions[_t] += 1
+            self._work.notify_all()
+        _events.record(_events.HEAD, "gcs", "RECONCILE_END", dict(stats))
+        sys.stderr.write(
+            "gcs: recovery window closed — "
+            f"actors restarted={stats['actors_restarted']} "
+            f"dead={stats['actors_dead']} "
+            f"tasks requeued={stats['tasks_requeued']} "
+            f"objects swept={stats['objects_swept']} "
+            f"lost={stats['lost']}\n"
+        )
         self._broadcast_free(freed)
 
     def _handle_node_death(self, nid: bytes, reason: str):
@@ -3534,6 +4070,11 @@ class GcsServer:
             outcome = self._try_place(spec, claims)
             if outcome in ("dispatched", "unschedulable"):
                 progressed = True
+                if outcome == "dispatched":
+                    # Queue -> inflight is durable (see class-queue
+                    # branch below).
+                    self._version += 1
+                    self._table_versions["pending"] += 1
             else:
                 special_requeue.append(spec)
         self._pending.special.extend(special_requeue)
@@ -3555,7 +4096,13 @@ class GcsServer:
                 )
                 if outcome in ("dispatched", "unschedulable"):
                     progressed = True
-                    dispatched_any = dispatched_any or outcome == "dispatched"
+                    if outcome == "dispatched":
+                        dispatched_any = True
+                        # Queue -> inflight is a durable transition now
+                        # (inflight specs persist with the pending
+                        # table so a head crash can't lose them).
+                        self._version += 1
+                        self._table_versions["pending"] += 1
                 elif outcome == "deferred":
                     deferred.append(spec)  # deps pending: skip, keep going
                 else:  # no capacity / no worker: class blocked this pass
@@ -3813,6 +4360,11 @@ class GcsServer:
         env = {
             "RAY_TPU_WORKER_ID": wid.hex(),
             "PYTHONUNBUFFERED": "1",  # prints reach the log tailer live
+            # Chaos rule scoping: a standalone head process carries
+            # role "head" (head_main) — its spawned workers must not
+            # inherit it or kill:gcs.* / ?role=head rules would fire
+            # inside workers.
+            "RAY_TPU_CHAOS_ROLE": "worker",
             # Current flight-recorder toggle: a worker spawned after
             # `events --record off` must not silently resume recording
             # (RayConfig reads this env override at worker boot).

@@ -27,6 +27,17 @@ def main(argv=None) -> int:
     parser.add_argument("--num-cpus", type=float, default=0.0)
     args = parser.parse_args(argv)
 
+    # Chaos rule scoping (?role=head, kill:gcs.*) + rebuild the
+    # schedule now that the role marker is set (the import-time install
+    # saw "driver"). Workers this head spawns get their role pinned
+    # back to "worker" in the spawn env (gcs._spawn_worker).
+    import os
+
+    os.environ["RAY_TPU_CHAOS_ROLE"] = "head"
+    from . import chaos as _chaos
+
+    _chaos.refresh()
+
     from .node import Node
 
     node = Node(

@@ -96,6 +96,13 @@ class OwnerRefTracker:
         # cluster-wide forever.
         self._seq = 0
         self._unacked: "OrderedDict[int, List]" = OrderedDict()
+        # Client conn generation the current numbering belongs to: a
+        # fresh conn means a fresh head-side sequencer, so flush()
+        # renumbers unacked batches before its first send on the new
+        # conn (checked under the lock — NOT only in on_reconnect, or
+        # a flush racing the conn swap would ship a stale seq and
+        # poison the new sequencer's baseline).
+        self._gen_seen = 0
         # Borrowers swept by borrower_died; late borrow adds for them
         # are stale and must be ignored (see DEAD_BORROWER_CAP).
         self._dead_borrowers: "OrderedDict[bytes, None]" = OrderedDict()
@@ -199,6 +206,50 @@ class OwnerRefTracker:
         if requeue:
             self._ensure_flusher()
 
+    def on_reconnect(self) -> Dict[bytes, List[bytes]]:
+        """The head restarted and this client re-registered on a fresh
+        connection. Three things must replay (the head's per-conn
+        sequencer numbers from 1 again and its object soft state is
+        being rebuilt from bearers of truth):
+
+        - unacked ref_flush batches renumber 1..k in their original
+          order and retransmit immediately (the old numbering would
+          read as a permanent gap to the new sequencer);
+        - live borrowed/fallback refs are marked un-advertised so the
+          next flush re-sends their badd/add edges;
+        - owned refs (silent while alive by design) are returned as a
+          reconcile payload — ``{oid: [borrower, ...]}`` — for the
+          client to re-advertise into the head's recovery window.
+        """
+        owned: Dict[bytes, List[bytes]] = {}
+        with self._lock:
+            self._maybe_renumber_locked()
+            for oid, n in self._counts.items():
+                if n <= 0:
+                    continue
+                owner = self._owner_of.get(oid, b"")
+                if owner == self._self_id:
+                    if oid in self._advertised:
+                        owned[oid] = sorted(self._borrows.get(oid, ()))
+                else:
+                    # Borrowed / head-fallback: re-advertise through the
+                    # normal flush path.
+                    self._advertised.discard(oid)
+                    self._dirty.add(oid)
+            # Owned oids kept alive only by remote borrowers (local
+            # count drained): still ours to re-advertise.
+            for oid, bs in self._borrows.items():
+                if (
+                    oid not in owned
+                    and self._owner_of.get(oid) == self._self_id
+                    and oid in self._advertised
+                ):
+                    owned[oid] = sorted(bs)
+            if self._dirty or self._unacked:
+                self._wake.set()
+        self._ensure_flusher()
+        return owned
+
     def sweep_borrower(self, borrower: bytes) -> None:
         """A borrowing process died without retracting its borrows."""
         requeue = False
@@ -224,6 +275,25 @@ class OwnerRefTracker:
 
     # ------------------------------------------------------------- flushing
 
+    def _maybe_renumber_locked(self) -> None:
+        """Caller holds self._lock. Renumber unacked batches 1..k
+        (original order, due immediately) when the client moved to a
+        new connection — see _gen_seen."""
+        client = self._client()
+        gen = getattr(client, "_conn_gen", 0) if client is not None else 0
+        if gen == self._gen_seen:
+            return
+        self._gen_seen = gen
+        old = list(self._unacked.values())
+        self._unacked.clear()
+        self._seq = 0
+        for rec in old:
+            self._seq += 1
+            rec[0]["seq"] = self._seq
+            rec[1] = 0.0  # due immediately
+            rec[2] = 1  # fresh head: reset the attempt budget
+            self._unacked[self._seq] = rec
+
     def _ensure_flusher(self):
         if self._flusher is None and not self._stopped:
             self._flusher = threading.Thread(
@@ -247,7 +317,17 @@ class OwnerRefTracker:
             time.sleep(FLUSH_INTERVAL_S)
             self._wake.clear()
             client = self._client()
-            if client is None or client.conn.closed:
+            if client is None:
+                return
+            if client.conn.closed:
+                # Head connection down. If a failover reconnect may
+                # still land, stay alive — the unacked batches and the
+                # reconcile re-advertisement need this thread after the
+                # swap. Otherwise the session is over.
+                if client.conn_failover_pending():
+                    self._wake.set()
+                    time.sleep(FLUSH_INTERVAL_S)
+                    continue
                 return
             self.flush(client)
 
@@ -311,6 +391,7 @@ class OwnerRefTracker:
         flush (idempotent set semantics server-side, so transient
         1->0->1 flaps are safe)."""
         with self._lock:
+            self._maybe_renumber_locked()
             if not self._dirty and not self._zeroed:
                 pending_ack = bool(self._unacked)
                 if not pending_ack:
@@ -369,7 +450,11 @@ class OwnerRefTracker:
         try:
             client.conn.send(msg)
         except ConnectionLost:
-            self._stopped = True
+            # The batch stays in _unacked; it retransmits on the next
+            # connection if a failover lands (the send was already
+            # at-least-once, so conn loss is just a longer gap).
+            if not client.conn_failover_pending():
+                self._stopped = True
             return
         self._retransmit_due(client)
 
@@ -409,7 +494,8 @@ class OwnerRefTracker:
             for m in resend:
                 client.conn.send(m)
         except ConnectionLost:
-            self._stopped = True
+            if not client.conn_failover_pending():
+                self._stopped = True
 
     def stop(self):
         self._stopped = True

@@ -166,6 +166,28 @@ class ObjectStore:
         except FileNotFoundError:
             return False
 
+    def location_of(self, object_id: ObjectID) -> Optional[str]:
+        """Directory location name for a sealed object this process can
+        see ("pool" or the shm segment name), or None when absent.
+        Used by head-failover reconciliation: a reconnecting owner
+        re-advertises where its objects live so a restarted head can
+        rebuild the (non-durable) location table from bearers of
+        truth."""
+        if self._pool is not None and self._pool.contains(object_id.binary()):
+            return "pool"
+        name = segment_name(object_id)
+        with self._lock:
+            if name in self._segments:
+                return name
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            _untrack(shm)
+            with self._lock:
+                self._segments[name] = shm
+            return name
+        except FileNotFoundError:
+            return None
+
     # ------------------------------------------------------ raw byte access
     # The transfer plane (object_transfer.py) moves objects between nodes
     # as raw serialized bytes; these methods expose the stored

@@ -157,6 +157,19 @@ class PeerConn:
         with self._pending_lock:
             self._pending.pop(req_id, None)
 
+    def _check_open_for_request(self, req_id: int) -> None:
+        """A reply future registered AFTER the reader's close cleanup
+        ran would never be failed — and a send into a dying socket can
+        still land in the kernel buffer without raising — so the caller
+        would block forever. The reader sets ``_closed`` before failing
+        its pending futures; checking it after registration closes the
+        race window (found as a wedged lease_worker request issued in a
+        head-failover kill window)."""
+        if self._closed.is_set():
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise ConnectionLost("peer connection closed")
+
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
         """Send and block for the correlated reply; returns reply dict.
 
@@ -168,6 +181,7 @@ class PeerConn:
         with self._pending_lock:
             self._pending[req_id] = fut
         try:
+            self._check_open_for_request(req_id)
             self.send(msg)
             return fut.result(timeout=timeout)
         finally:
@@ -183,6 +197,7 @@ class PeerConn:
         with self._pending_lock:
             self._pending[req_id] = fut
         try:
+            self._check_open_for_request(req_id)
             self.send(msg)
         except BaseException:
             with self._pending_lock:

@@ -443,6 +443,27 @@ CHAOS_SMOKE = {
     "spec": CHAOS_SPEC,
 }
 
+# Head-failover soak: the head itself is the kill target. Message
+# chaos stays on the at-least-once paths (dup/delay on done batches
+# and ref flushes exercises the per-conn sequencers across the
+# restart); the kills are supervisor SIGKILLs on a seeded cadence.
+FAILOVER_SPEC = (
+    "task_done_batch=dup:0.05,"
+    "task_done_batch=delay:0.05:2000:20000,"
+    "ref_flush=dup:0.05,"
+    "ref_flush=delay:0.05:2000:20000"
+)
+FAILOVER_FULL = {
+    "seconds": 150, "nodes": 3, "seed": 0xFA110, "kill_every_s": 35.0,
+    "head_kills": 3, "payload_bytes": 96 << 10, "get_timeout_s": 120.0,
+    "spec": FAILOVER_SPEC,
+}
+FAILOVER_SMOKE = {
+    "seconds": 45, "nodes": 2, "seed": 0xFA110, "kill_every_s": 15.0,
+    "head_kills": 1, "payload_bytes": 64 << 10, "get_timeout_s": 90.0,
+    "spec": FAILOVER_SPEC,
+}
+
 
 @ray_tpu.remote(num_cpus=1)
 def _envelope_fetch(x):
@@ -1026,6 +1047,296 @@ def bench_chaos_soak(cfg: Dict[str, float]):
                 pass
 
 
+@ray_tpu.remote(max_restarts=20)
+class _FailoverCounter:
+    """Detached + restartable actor for the failover soak: must stay
+    callable through every head kill (claimed by its surviving worker
+    during the recovery window, or recreated from the durable actor
+    table)."""
+
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+
+def bench_head_failover(cfg: Dict[str, float]):
+    """Seeded head-failover soak (acceptance: ISSUE 9): a supervised
+    standalone head is SIGKILL'd N times under concurrent task/actor/
+    object traffic from a live driver and real node daemons — asserting
+    (a) zero wedged ray.get futures, (b) traffic keeps completing
+    across every restart (client/worker reconnect + recovery window),
+    (c) a detached restartable actor stays callable, (d) kv written
+    before a kill survives it, (e) no leaked directory entries once
+    refs drop, and (f) the failover is observable (HEAD/RECONCILE
+    flight-recorder events). Deterministic per seed; a red run
+    reproduces with the printed seed."""
+    import gc
+    import os
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from ray_tpu.cluster_utils import DaemonCluster, SupervisedHead
+    from ray_tpu._private import chaos as _chaos
+    from ray_tpu._private.config import RayConfig
+    from ray_tpu._private.state import list_cluster_events
+    from ray_tpu._private.worker import global_client
+    from ray_tpu.exceptions import GetTimeoutError
+
+    seed = int(cfg["seed"])
+    spec = str(cfg["spec"])
+    seconds = float(cfg["seconds"])
+    max_kills = int(cfg["head_kills"])
+    print(f"head_failover: seed={seed} (reproduce with --chaos-seed {seed})")
+    print(f"head_failover: spec={spec}")
+
+    # The soak needs an EXTERNAL head a supervisor can SIGKILL; the
+    # session main() opened is in-process — replace it.
+    ray_tpu.shutdown()
+    session_dir = tempfile.mkdtemp(prefix="rtpu_failover_")
+    chaos_env = {
+        "RAY_TPU_chaos_spec": spec,
+        "RAY_TPU_chaos_seed": str(seed),
+    }
+    os.environ.update(chaos_env)
+    RayConfig._values["chaos_spec"] = spec
+    RayConfig._values["chaos_seed"] = seed
+    _chaos.install(spec, seed, RayConfig.testing_rpc_delay_us)
+    try:
+        head = SupervisedHead(session_dir=session_dir, env=chaos_env)
+    except (RuntimeError, TimeoutError, OSError) as e:
+        RESULTS["head_failover_skipped"] = 1.0  # counted, never silent
+        print(f"head_failover: SKIPPED — cannot launch external head: {e}")
+        return
+    rng = random.Random(seed)
+    cluster = None
+    stop = threading.Event()
+    stats = {"ok": 0, "failed": 0, "actor_ok": 0, "kills": 0}
+    wedged: List[str] = []
+    get_timeout = float(cfg["get_timeout_s"])
+    payload_n = max(1024, int(cfg["payload_bytes"]) // 8)
+    try:
+        ray_tpu.init(address=head.address)
+        client = global_client()
+        cluster = DaemonCluster.attach(head.tcp_address, head.authkey)
+        for i in range(int(cfg["nodes"])):
+            cluster.add_node(num_cpus=2, label=f"fo{i}")
+
+        # Warm one worker per node, then take the leak baseline.
+        ray_tpu.get(
+            [_chaos_chew.remote([float(i)]) for i in range(int(cfg["nodes"]))],
+            timeout=300,
+        )
+        counter = _FailoverCounter.options(
+            name="failover_counter", lifetime="detached"
+        ).remote()
+        assert ray_tpu.get(counter.bump.remote(), timeout=60) >= 1
+        gc.collect()
+        client._tracker.flush(client)
+        time.sleep(1.0)
+
+        def entry_count() -> int:
+            r = client.state_read(
+                {"type": "list_state", "kind": "objects", "limit": 1}
+            )
+            return int(r.get("total", 0))
+
+        baseline_entries = entry_count()
+
+        wedged_refs: List = []
+
+        def _attribute_wedge(tag: str, ref, exc) -> None:
+            wedged.append(f"{tag}: {exc}")
+            wedged_refs.append((tag, ref))
+
+        def traffic(idx: int):
+            lrng = random.Random(seed ^ (idx + 1))
+            base = np.ones(payload_n)
+            while not stop.is_set():
+                try:
+                    ref = ray_tpu.put(base * lrng.random())
+                    r1 = _chaos_chew.remote(ref)
+                    r2 = _chaos_chew.remote(r1)
+                    out = ray_tpu.get(r2, timeout=get_timeout)
+                    assert len(out) > 0
+                    stats["ok"] += 1
+                    del ref, r1, r2, out
+                except GetTimeoutError as e:
+                    _attribute_wedge(f"traffic[{idx}]", r2, e)
+                    return
+                except Exception:  # noqa: BLE001 - kills make failures legal
+                    stats["failed"] += 1
+                    time.sleep(0.2)
+
+        def actor_loop():
+            while not stop.is_set():
+                ref = None
+                try:
+                    ref = counter.bump.remote()
+                    n = ray_tpu.get(ref, timeout=get_timeout)
+                    assert n >= 1
+                    stats["actor_ok"] += 1
+                    time.sleep(0.2)
+                except GetTimeoutError as e:
+                    _attribute_wedge("actor", ref, e)
+                    return
+                except Exception:  # noqa: BLE001 - restart window
+                    stats["failed"] += 1
+                    time.sleep(0.3)
+
+        threads = [
+            threading.Thread(target=traffic, args=(i,), daemon=True)
+            for i in range(2)
+        ] + [threading.Thread(target=actor_loop, daemon=True)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+
+        # Kill loop: SIGKILL the live head on a seeded cadence; the
+        # supervisor relaunches it on the same address and everyone
+        # reconnects. kv written before each kill must survive it.
+        next_kill = time.monotonic() + float(cfg["kill_every_s"]) * (
+            0.75 + 0.5 * rng.random()
+        )
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline and not wedged:
+            time.sleep(0.25)
+            if stats["kills"] >= max_kills or time.monotonic() < next_kill:
+                continue
+            next_kill = time.monotonic() + float(cfg["kill_every_s"]) * (
+                0.75 + 0.5 * rng.random()
+            )
+            marker = f"pre_kill_{stats['kills']}".encode()
+            try:
+                client.kv_put(marker, b"survives")
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)  # let a persist tick capture the marker
+            restarts_before = head.restarts
+            head.kill()
+            stats["kills"] += 1
+            print(f"head_failover: killed head (#{stats['kills']})")
+            if not head.wait_restarted(restarts_before + 1, timeout=60):
+                wedged.append("head never restarted")
+                break
+        stop.set()
+        for t in threads:
+            t.join(timeout=get_timeout + 60)
+            if t.is_alive():
+                wedged.append(f"{t.name} did not finish after stop")
+        soak_s = time.perf_counter() - t0
+
+        # ---------------------------------------------------- assertions
+        kv_lost = 0
+        for k in range(stats["kills"]):
+            try:
+                if client.kv_get(f"pre_kill_{k}".encode()) != b"survives":
+                    kv_lost += 1
+            except Exception:  # noqa: BLE001
+                kv_lost += 1
+        final_bump = None
+        try:
+            final_bump = ray_tpu.get(counter.bump.remote(), timeout=60)
+        except Exception:  # noqa: BLE001
+            pass
+        gc.collect()
+        client._tracker.flush(client)
+        leak_deadline = time.monotonic() + 60
+        leaked = entry_count() - baseline_entries
+        while time.monotonic() < leak_deadline and leaked > 16:
+            gc.collect()
+            client._tracker.flush(client)
+            time.sleep(1.0)
+            leaked = entry_count() - baseline_entries
+        head_events = list_cluster_events(category="head", limit=10_000)
+        event_kinds = {e["event"] for e in head_events}
+
+        RESULTS["head_failover_seconds"] = round(soak_s, 1)
+        RESULTS["head_failover_kills"] = stats["kills"]
+        RESULTS["head_failover_ops_ok"] = stats["ok"] + stats["actor_ok"]
+        RESULTS["head_failover_ops_failed"] = stats["failed"]
+        RESULTS["head_failover_leaked_entries"] = max(0, leaked)
+        print(
+            f"head_failover: {soak_s:.0f}s, kills={stats['kills']} "
+            f"(restarts={head.restarts}), ops ok={stats['ok']}"
+            f"+{stats['actor_ok']} failed={stats['failed']}, "
+            f"final actor bump={final_bump}, kv lost={kv_lost}, "
+            f"leaked entries={max(0, leaked)}, head events={sorted(event_kinds)}"
+        )
+        # Attribution for any wedged get: what head-side state pinned
+        # it? (Same convention as chaos_soak's residual-entry dump.)
+        for tag, ref in wedged_refs:
+            if ref is None:
+                continue
+            try:
+                oid = ref.id().hex()
+                r = client.state_read(
+                    {"type": "list_state", "kind": "objects",
+                     "limit": 200_000}
+                )
+                ent = [i for i in r.get("items", [])
+                       if i["object_id"] == oid]
+                print(f"head_failover: wedged {tag} oid={oid} entry={ent}")
+                r = client.state_read(
+                    {"type": "list_state", "kind": "actors", "limit": 100}
+                )
+                print(f"head_failover: actors={r.get('items')}")
+            except Exception as e:  # noqa: BLE001
+                print(f"head_failover: wedge attribution failed: {e}")
+        problems = []
+        if wedged:
+            problems.append(f"wedged futures: {wedged}")
+        if stats["kills"] == 0:
+            problems.append("kill loop never fired")
+        if stats["ok"] < 10:
+            problems.append(f"traffic starved: only {stats['ok']} ops")
+        if stats["actor_ok"] < 3:
+            problems.append(
+                f"actor starved: only {stats['actor_ok']} bumps"
+            )
+        if final_bump is None:
+            problems.append("actor not callable after final failover")
+        if kv_lost:
+            problems.append(f"{kv_lost} pre-kill kv markers lost")
+        if leaked > 16:
+            problems.append(f"{leaked} directory entries leaked")
+        if not event_kinds & {"RECONCILE_END", "HEAD_RECONNECT"}:
+            problems.append(
+                "no failover flight-recorder events — instrumentation dark?"
+            )
+        if problems:
+            RESULTS["head_failover_ok"] = 0.0
+            raise RuntimeError(
+                f"head_failover FAILED (seed={seed}; reproduce with "
+                f"--only head_failover --chaos-seed {seed}): "
+                + "; ".join(problems)
+            )
+        RESULTS["head_failover_ok"] = 1.0
+    finally:
+        stop.set()
+        for key in chaos_env:
+            os.environ.pop(key, None)
+        RayConfig._values["chaos_spec"] = ""
+        RayConfig._values["chaos_seed"] = 0
+        _chaos.install("", 0, RayConfig.testing_rpc_delay_us)
+        if cluster is not None:
+            for proc in list(cluster._daemons):
+                try:
+                    cluster.kill_node(proc)
+                except Exception:  # noqa: BLE001
+                    pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        head.stop()
+        shutil.rmtree(session_dir, ignore_errors=True)
+
+
 def bench_placement_groups():
     from ray_tpu.util.placement_group import (
         placement_group,
@@ -1047,7 +1358,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--only", default=None,
         help="comma-separated subset: tasks,actors,objects,pgs,scale,"
-        "object_envelope,chaos_soak",
+        "object_envelope,chaos_soak,head_failover",
     )
     parser.add_argument(
         "--envelope-smoke", action="store_true",
@@ -1061,6 +1372,11 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--chaos-smoke", action="store_true",
         help="short seeded chaos_soak config (make chaos-smoke)",
+    )
+    parser.add_argument(
+        "--failover-smoke", action="store_true",
+        help="short head_failover config: 1 head kill, small cluster, "
+        "bounded wall time (make failover-smoke)",
     )
     parser.add_argument(
         "--chaos-seed", type=int, default=None,
@@ -1097,6 +1413,13 @@ def main(argv=None) -> int:
         chaos_cfg["seed"] = args.chaos_seed
     if args.chaos_seconds is not None:
         chaos_cfg["seconds"] = args.chaos_seconds
+    failover_cfg = dict(
+        FAILOVER_SMOKE if args.failover_smoke else FAILOVER_FULL
+    )
+    if args.chaos_seed is not None:
+        failover_cfg["seed"] = args.chaos_seed
+    if args.chaos_seconds is not None:
+        failover_cfg["seconds"] = args.chaos_seconds
     groups = {
         "tasks": bench_tasks,
         "actors": bench_actor_calls,
@@ -1105,11 +1428,13 @@ def main(argv=None) -> int:
         "scale": bench_scale,
         "object_envelope": lambda: bench_object_envelope(env_cfg),
         "chaos_soak": lambda: bench_chaos_soak(chaos_cfg),
+        "head_failover": lambda: bench_head_failover(failover_cfg),
     }
+    _opt_in = ("object_envelope", "chaos_soak", "head_failover")
     selected = (
         [s.strip() for s in args.only.split(",")]
         if args.only
-        else [g for g in groups if g not in ("object_envelope", "chaos_soak")]
+        else [g for g in groups if g not in _opt_in]
     )
     # DaemonCluster nodes need the TCP control plane; harmless otherwise.
     init_kwargs = {"num_cpus": args.num_cpus}

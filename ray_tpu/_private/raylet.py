@@ -98,7 +98,18 @@ class NodeDaemon:
             self.store, f"{transfer_host}:0", authkey
         )
 
-        raw = transport.connect(gcs_address, authkey)
+        # Initial head connect rides the one shared retry policy (full
+        # jitter + budget): a daemon booted while the head restarts —
+        # or pointed at a supervisor-managed head mid-failover — must
+        # absorb refused connects instead of dying on the first one.
+        raw = _chaos.retry_call(
+            lambda: transport.connect(gcs_address, authkey),
+            retry_on=(OSError,),
+            backoff=_chaos.Backoff(
+                base_s=0.25, cap_s=3.0,
+                budget_s=RayConfig.worker_register_timeout_s,
+            ),
+        )
         self.conn = PeerConn(
             raw,
             push_handler=self._on_push,
@@ -222,6 +233,10 @@ class NodeDaemon:
             "RAY_TPU_NODE_NS": self.node_ns,
             "PYTHONUNBUFFERED": "1",  # prints reach the log tailer live
             "RAY_TPU_NODE_ID": self.node_id.hex(),
+            # Chaos rule scoping: workers must not inherit this
+            # daemon's "raylet" role marker (?role=worker rules would
+            # never fire in daemon-spawned workers).
+            "RAY_TPU_CHAOS_ROLE": "worker",
             # Current flight-recorder toggle (this daemon tracks the
             # cluster-wide broadcast): a worker spawned after
             # `events --record off` must not silently resume recording.
@@ -517,11 +532,13 @@ class NodeDaemon:
                 continue
 
     def _on_gcs_close(self):
-        # Head died (restarting) or network partition. Take the workers
-        # down — their control conns died with the head — but keep the
-        # daemon alive and try to rejoin a restarted head for a grace
-        # window before giving up (reference: raylets re-register after
-        # NotifyGCSRestart; exit only when no restart arrives).
+        # Head died (restarting) or network partition. Keep the daemon
+        # AND its workers alive: each worker's CoreClient rides the
+        # failover itself (reconnect + re-registration + reconcile), so
+        # a head blip must not become a full node restart — running
+        # tasks keep executing and re-claim on the restarted head
+        # (reference: raylets re-register after NotifyGCSRestart;
+        # workers only die when no restart ever arrives).
         if self._shutdown.is_set():
             return
         with self._lock:
@@ -532,12 +549,11 @@ class NodeDaemon:
             if self._rejoining:
                 return
             self._rejoining = True
-            workers = list(self._workers.values())
-            self._workers.clear()
-        for proc in workers:
-            proc.terminate()
         try:
-            deadline = time.time() + RayConfig.worker_register_timeout_s
+            deadline = time.time() + max(
+                RayConfig.worker_register_timeout_s,
+                RayConfig.gcs_reconnect_budget_s,
+            )
             # Exponential backoff + jitter (the one shared policy):
             # every daemon in a fleet lost its head at the same
             # instant, and N synchronized 0.5s probes against a

@@ -148,6 +148,67 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
             )
     except Exception:  # noqa: BLE001 - recorder disabled or old head
         pass
+    try:
+        # Failover rows (pid "failover"): HEAD_DOWN/HEAD_RECONNECT
+        # pairs per client render as duration slices (the outage window
+        # each process observed), RECONCILE_BEGIN/RECONCILE_END as the
+        # head's recovery window, and claims/ghost sweeps as instants —
+        # so a failover's outage and reconcile durations are measurable
+        # per session straight from the timeline.
+        head_events = list_cluster_events(category="head", limit=100_000)
+        downs: Dict[str, Dict[str, Any]] = {}
+        begin: Optional[Dict[str, Any]] = None
+        for ev in head_events:
+            name, entity = ev["event"], ev["entity"]
+            base = {
+                "cat": "failover",
+                "pid": "failover",
+                "tid": entity,
+                "args": {**(ev.get("attrs") or {}), "entity": entity},
+            }
+            if name == "HEAD_DOWN":
+                downs[entity] = ev
+                continue
+            if name == "HEAD_RECONNECT" and entity in downs:
+                t0 = downs.pop(entity)["timestamp"]
+                trace.append(
+                    {
+                        **base, "name": "outage", "ph": "X",
+                        "ts": t0 * 1e6,
+                        "dur": max(0.0, ev["timestamp"] - t0) * 1e6,
+                    }
+                )
+                continue
+            if name == "RECONCILE_BEGIN":
+                begin = ev
+                continue
+            if name == "RECONCILE_END" and begin is not None:
+                t0 = begin["timestamp"]
+                trace.append(
+                    {
+                        **base, "name": "recovery_window", "ph": "X",
+                        "ts": t0 * 1e6,
+                        "dur": max(0.0, ev["timestamp"] - t0) * 1e6,
+                    }
+                )
+                begin = None
+                continue
+            trace.append(
+                {**base, "name": name, "ph": "i",
+                 "ts": ev["timestamp"] * 1e6, "s": "g"}
+            )
+        # Unpaired HEAD_DOWNs (reconnect never landed) stay visible.
+        for entity, ev in downs.items():
+            trace.append(
+                {
+                    "name": "HEAD_DOWN", "cat": "failover",
+                    "pid": "failover", "tid": entity, "ph": "i",
+                    "ts": ev["timestamp"] * 1e6, "s": "g",
+                    "args": {**(ev.get("attrs") or {}), "entity": entity},
+                }
+            )
+    except Exception:  # noqa: BLE001 - recorder disabled or old head
+        pass
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -170,6 +170,11 @@ def init(
         _global.client = CoreClient(
             address_, authkey, role=DRIVER_MODE, transfer_addr=transfer_addr,
             push_handler=_driver_push,
+            # External heads can be restarted under this driver (a
+            # supervisor relaunches them on the same address): ride the
+            # failover. An in-process head dies with this process — no
+            # reconnect target exists.
+            reconnect=address is not None,
         )
         _global.mode = DRIVER_MODE
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":

@@ -34,6 +34,7 @@ def _finite(value, default: float, cap: float, floor: float = 0.0) -> float:
     if v != v:  # NaN
         return default
     return min(max(v, floor), cap)
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
@@ -144,6 +145,10 @@ class _DoneBatcher:
 
     _MAX_BATCH = 256
     _FLUSH_INTERVAL_S = 0.004
+    #: At-least-once across head failover: unacked batches older than
+    #: this resend (the head acks on receipt and dedups per conn).
+    _RETRANSMIT_S = 1.0
+    _RETRANSMIT_MAX = 20
 
     def __init__(self, client: CoreClient):
         self._client = client
@@ -152,6 +157,77 @@ class _DoneBatcher:
         self._items: list = []
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # seq -> [msg, sent_at, attempts]: every item-carrying batch is
+        # numbered and retained until the head acks it. A head crash
+        # between this worker answering its caller and the directory
+        # hearing the completion would otherwise lose the seal forever
+        # (the head's object soft state is rebuilt from bearers of
+        # truth, and for completions this batcher IS the bearer).
+        self._seq = 0
+        self._unacked: "OrderedDict[int, list]" = OrderedDict()
+        #: Client conn generation the current numbering belongs to. A
+        #: fresh conn means a fresh head-side sequencer (start_seq=1),
+        #: so EVERY send path must renumber before its first send on
+        #: the new conn — checked inside flush() under the lock, not
+        #: just in on_reconnect, or a completion flushed between the
+        #: conn swap and the reconnect callback would ship a stale seq
+        #: and poison the new sequencer's baseline.
+        self._gen_seen = 0
+        self.lost_batches = 0
+        client.done_ack = self.ack
+
+    def ack(self, seq: int) -> None:
+        with self._lock:
+            self._unacked.pop(seq, None)
+
+    def _maybe_renumber_locked(self) -> None:
+        """Caller holds self._lock. Renumber the unacked batches 1..k
+        (original order) when the client moved to a new connection —
+        the restarted head's per-conn sequencer numbers from 1 again;
+        re-applying completions is idempotent head-side."""
+        gen = getattr(self._client, "_conn_gen", 0)
+        if gen == self._gen_seen:
+            return
+        self._gen_seen = gen
+        old = list(self._unacked.values())
+        self._unacked.clear()
+        self._seq = 0
+        for rec in old:
+            self._seq += 1
+            rec[0]["seq"] = self._seq
+            rec[1] = 0.0  # due immediately
+            rec[2] = 1  # fresh head: reset the attempt budget
+            self._unacked[self._seq] = rec
+
+    def on_reconnect(self) -> None:
+        """Head restarted on a fresh conn: replay the unacked batches
+        now (flush renumbers them for the new conn generation)."""
+        self._wake.set()
+        self.flush()
+
+    def _retransmit_due(self) -> None:
+        now = time.monotonic()
+        resend = []
+        with self._lock:
+            for seq, rec in list(self._unacked.items()):
+                if now - rec[1] < self._RETRANSMIT_S:
+                    break  # OrderedDict: the rest are younger
+                if rec[2] >= self._RETRANSMIT_MAX:
+                    del self._unacked[seq]
+                    self.lost_batches += 1  # counted, never silent
+                    continue
+                rec[1] = now
+                rec[2] += 1
+                resend.append(rec[0])
+        if not resend:
+            return
+        from .protocol import ConnectionLost
+
+        try:
+            for m in resend:
+                self._client.send(m)
+        except ConnectionLost:
+            pass  # still unacked; the reconnect replay re-sends
 
     def add(self, item: Dict[str, Any]) -> None:
         with self._lock:
@@ -175,19 +251,34 @@ class _DoneBatcher:
         # it was barriering on arrives.
         with self._send_lock:
             with self._lock:
+                self._maybe_renumber_locked()
                 items, self._items = self._items, []
+                base = None
+                if items:
+                    self._seq += 1
+                    base = {
+                        "type": "task_done_batch",
+                        "worker_id": self._client.worker_id.binary(),
+                        "items": items,
+                        "seq": self._seq,
+                    }
+                    # Retain the ack-tracked copy WITHOUT the event
+                    # piggyback below: a retransmit must not double-
+                    # ingest flight-recorder events head-side.
+                    self._unacked[self._seq] = [base, time.monotonic(), 1]
             # Flight-recorder piggyback: the ring ships on the flush
             # that already exists instead of its own timer/message
             # (reference: task events batch with the state updates,
             # task_event_buffer.h).
             rec = _events.get_recorder()
-            msg = {
+            msg = dict(base) if base is not None else {
                 "type": "task_done_batch",
                 "worker_id": self._client.worker_id.binary(),
-                "items": items,
+                "items": [],
             }
             ev_items, ev_dropped = rec.attach(msg)
-            if not items and not ev_items and not ev_dropped:
+            if base is None and not ev_items and not ev_dropped:
+                self._retransmit_due()
                 return
             if items:
                 # Chaos: worker dies after answering its callers but
@@ -199,17 +290,30 @@ class _DoneBatcher:
             try:
                 self._client.send(msg)
             except ConnectionLost:
+                # The batch stays unacked (retransmitted after the
+                # failover); only the piggybacked events are lost.
                 rec.count_lost(ev_items, ev_dropped)
+            self._retransmit_due()
 
     def _loop(self) -> None:
         # Park until work arrives — an idle worker must cost ZERO
         # wakeups (with hundreds of actors on a node, a per-worker
         # polling timer is itself the scale bottleneck: 150 actors x
         # 250 polls/s saturated a core before any real work ran).
-        while not self._client.conn.closed:
-            self._wake.wait()
-            if self._client.conn.closed:
-                return
+        # With unacked batches outstanding the park is bounded so
+        # retransmits run even when no new completions arrive.
+        while True:
+            self._wake.wait(
+                self._RETRANSMIT_S / 2 if self._unacked else None
+            )
+            client = self._client
+            if client.conn.closed:
+                if not client.conn_failover_pending():
+                    return
+                # Head outage: hold everything; the reconnect replay
+                # (on_reconnect) flushes the moment the new conn lands.
+                time.sleep(0.1)
+                continue
             # Coalescing window: let the burst in flight accumulate
             # into one task_done_batch message.
             time.sleep(self._FLUSH_INTERVAL_S)
@@ -246,6 +350,16 @@ class WorkerRuntime:
         self._done = threading.Event()
         self._done_batcher = _DoneBatcher(client)
         self._wid_hex = client.worker_id.hex()
+        # Head-failover reconciliation state (bearers of truth): tasks
+        # currently executing in this process (task_id -> return oids;
+        # the oids let a restarted head protect in-flight LEASED/direct
+        # tasks' returns — which it has no spec for — from the
+        # lost-producer sweep) and a bounded ledger of store-backed
+        # results this worker sealed (oid -> location) — both
+        # re-reported to a restarted head so it can rebuild its
+        # non-durable inflight/location tables.
+        self._executing: Dict[bytes, tuple] = {}
+        self._sealed_locs: "OrderedDict[bytes, str]" = OrderedDict()
         # Serializes execution across the main loop (GCS-routed tasks)
         # and direct-conn reader threads (inline fast calls): serial
         # workers run exactly one task at a time no matter which path
@@ -314,6 +428,16 @@ class WorkerRuntime:
             return
         self._execute_inline(frame, peer)
 
+    _SEALED_LEDGER_CAP = 8192
+
+    def _note_sealed(self, oid: bytes, loc: str) -> None:
+        """Remember where a store-backed result lives (failover
+        reconcile re-reports it; bounded FIFO)."""
+        led = self._sealed_locs
+        led[oid] = loc
+        while len(led) > self._SEALED_LEDGER_CAP:
+            led.popitem(last=False)
+
     def _execute_inline(self, frame, peer) -> None:
         """Lean serial executor for OP_CALL frames: no shim TaskSpec, one
         results pass building both the reply tuples and the (batched)
@@ -328,6 +452,9 @@ class WorkerRuntime:
         t_fork = time.time() if _rec.enabled else 0.0
         t_start = 0.0
         tid_hex = tid.hex()
+        self._executing[tid] = tuple(
+            tid[:12] + i.to_bytes(4, "little") for i in range(nret)
+        )
         with self._lock_for(aid):
             _events.set_task_context(tid_hex)
             try:
@@ -421,6 +548,7 @@ class WorkerRuntime:
                 "error": error_blob,
             }
         )
+        self._executing.pop(tid, None)
         # t_fork truthy too: recording may have been toggled on
         # mid-execution, and a half-captured span (0.0 boundaries)
         # would poison the phase histograms with epoch-sized phases.
@@ -635,10 +763,10 @@ class WorkerRuntime:
                     error_blob = serialization.pack(
                         RayTaskError(spec.name, e2.traceback_str)
                     )
-            self.client.send(
+            # Batcher, not a raw send: the stream close must survive a
+            # head outage (and never raise into the event loop).
+            self._done_batcher.add(
                 {
-                    "type": "task_done",
-                    "worker_id": wid,
                     "task_id": tid,
                     "name": spec.name,
                     "results": [],
@@ -646,6 +774,7 @@ class WorkerRuntime:
                     "streaming_total": idx,
                 }
             )
+            self._done_batcher.flush()
 
         asyncio.run_coroutine_threadsafe(stream_runner(), self._aio_loop)
 
@@ -734,6 +863,7 @@ class WorkerRuntime:
                 self.client.store, _OID(oid_bytes), payload, buffers, size
             )
             d["size"] = size
+            self._note_sealed(oid_bytes, d["segment"])
         return d
 
     def _stream_results(self, spec: TaskSpec, value: Any, origin=None,
@@ -781,10 +911,10 @@ class WorkerRuntime:
                 error_blob = serialization.pack(
                     RayTaskError(spec.name, exc.traceback_str)
                 )
-        self.client.send(
+        # Batcher, not a raw send: the stream close must survive a head
+        # outage (and never raise out of the execution loop).
+        self._done_batcher.add(
             {
-                "type": "task_done",
-                "worker_id": wid,
                 "task_id": tid,
                 "name": spec.name,
                 "results": [],
@@ -792,6 +922,7 @@ class WorkerRuntime:
                 "streaming_total": idx,
             }
         )
+        self._done_batcher.flush()
         if origin is not None:
             peer, req_id, lazy = origin
             from .protocol import ConnectionLost
@@ -867,6 +998,7 @@ class WorkerRuntime:
                             self.client.store, oid, payload, buffers, size
                         )
                         results[i].update(segment=name, size=size)
+                        self._note_sealed(oid.binary(), name)
         if origin is not None:
             # Direct call: answer on the caller's connection with a
             # compact reply frame. Results ride inline; larger values
@@ -915,9 +1047,7 @@ class WorkerRuntime:
                 pass
         if origin is not None and not spec.actor_creation:
             return
-        msg = {
-            "type": "task_done",
-            "worker_id": self.client.worker_id.binary(),
+        item = {
             "task_id": spec.task_id.binary(),
             "name": spec.name,
             "results": results,
@@ -943,13 +1073,22 @@ class WorkerRuntime:
             if held:
                 for oid in held:
                     tracker.mark_advertised(oid)
-                msg["borrows"] = list(held)
+                item["borrows"] = list(held)
         if origin is not None:
-            msg["direct"] = True
+            item["direct"] = True
         if spec.actor_creation:
-            msg["actor_creation"] = True
-            msg["actor_id"] = spec.actor_id.binary()
-        self.client.send(msg)
+            item["actor_creation"] = True
+            item["actor_id"] = spec.actor_id.binary()
+        # Through the at-least-once batcher, like the direct path: a
+        # raw send here would (a) LOSE the completion if the head is
+        # mid-restart and (b) raise ConnectionLost out of the execution
+        # loop — killing this worker (and its actor) on every head
+        # outage a task completes inside. The batcher retains the
+        # record until the (possibly restarted) head acks it. Eager
+        # flush keeps the old wire latency: the submitter's get is
+        # parked head-side on exactly this seal.
+        self._done_batcher.add(item)
+        self._done_batcher.flush()
         if _chaos._active is not None:
             # Chaos: named per-task kill point — "kill the owner
             # between SEAL and REF_FLUSH" targets exactly the task
@@ -962,6 +1101,12 @@ class WorkerRuntime:
     def _execute(self, spec: TaskSpec, origin=None):
         _rec = _events.get_recorder()
         t_fork = time.time() if _rec.enabled else 0.0
+        tid_b = spec.task_id.binary()
+        self._executing[tid_b] = (
+            tuple(o.binary() for o in spec.return_object_ids())
+            if spec.num_returns > 0
+            else ()
+        )
         _events.set_task_context(spec.task_id.hex())
         try:
             value = self._run_user_code(spec)
@@ -975,8 +1120,10 @@ class WorkerRuntime:
             # Failures before iteration (bad args, fetch error) must
             # still end the stream or consumers park forever.
             self._stream_results(spec, value, origin, exc=exc)
+            self._executing.pop(tid_b, None)
             return
         self._report_done(spec, value, exc, origin)
+        self._executing.pop(tid_b, None)
         # t_fork truthy too: a mid-execution toggle-on must not ship a
         # half-captured span (0.0 boundaries poison the histograms).
         if _rec.enabled and t_fork:
@@ -1313,16 +1460,53 @@ def main():
     # requesting worker; see CoreClient.state_read).
     client.pre_state_read_flush = rt._done_batcher.flush
 
+    # Head-failover reconciliation (reference: bearers of truth
+    # re-report after NotifyGCSRestart). The reconnect hello carries
+    # what this process authoritatively knows — hosted actors, tasks
+    # mid-execution, and where its sealed results live — and the
+    # post-reconnect callback replays the unacked done batches and
+    # drops actor instances the restarted head refused to re-bind.
+    def _reconcile_info():
+        from .ids import ObjectID as _OID
+
+        sealed = []
+        for oid, loc in list(rt._sealed_locs.items()):
+            if client.store.contains(_OID(oid)):
+                sealed.append((oid, loc))
+            else:
+                rt._sealed_locs.pop(oid, None)  # evicted/freed: stale
+        return {
+            "actors": list(rt.actors.keys()),
+            "shared_host": rt._shared_host,
+            "executing": [
+                (tid, list(oids))
+                for tid, oids in list(rt._executing.items())
+            ],
+            "sealed": sealed,
+        }
+
+    def _on_reconnected(reply):
+        for aid in reply.get("drop_actors") or ():
+            rt.actors.pop(aid, None)
+            rt._actor_locks.pop(aid, None)
+        rt._done_batcher.on_reconnect()
+
+    client.reconcile_info = _reconcile_info
+    client.on_reconnected = _on_reconnected
+
     # Make the ray_tpu API usable from inside tasks (nested submission).
     from . import worker as worker_api
 
     worker_api.connect_existing(client, mode="worker")
 
-    # Exit when the GCS goes away (driver died).
+    # Exit when the GCS goes away for good. A closed conn alone is no
+    # longer terminal — the client rides a head restart (reconnect with
+    # backoff + re-registration); only a reconnect that exhausts its
+    # budget (or an explicit close) sets head_permanently_lost.
     def watch_conn():
-        # Block on the reader's closed event — no polling (idle workers
-        # must cost zero wakeups; see the many-actor scale stress).
-        client.conn._closed.wait()
+        # Block on the event — no polling (idle workers must cost zero
+        # wakeups; see the many-actor scale stress).
+        client.head_permanently_lost.wait()
         os._exit(0)
 
     threading.Thread(target=watch_conn, daemon=True).start()

@@ -13,8 +13,12 @@ Reference: python/ray/cluster_utils.py:135. Two levels of realism:
 """
 from __future__ import annotations
 
+import os
+import secrets
+import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -69,6 +73,163 @@ class Cluster:
         ray_tpu.shutdown()
 
 
+def _pinned_pythonpath() -> str:
+    """PYTHONPATH with this very package's root first: subprocesses
+    (head_main, raylet) must resolve ray_tpu even when the launching
+    process runs from an unrelated cwd."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__
+    )))
+    return os.pathsep.join(
+        p for p in (repo, os.environ.get("PYTHONPATH", "")) if p
+    )
+
+
+class SupervisedHead:
+    """Standalone head process (``ray_tpu._private.head_main``) under a
+    tiny supervisor: when the head dies — SIGKILL'd by a chaos test or
+    by a ``kill:gcs.*`` kill point inside it — it is relaunched on the
+    SAME port and session dir, so the new head restores the persisted
+    GCS tables and live drivers/raylets/workers reconnect to it
+    (reference: the external supervisor keeping gcs_server alive that
+    NotifyGCSRestart assumes).
+
+    The head-failover chaos scenario drives this; tests use it to kill
+    a live head out from under a connected driver.
+    """
+
+    def __init__(
+        self,
+        session_dir: str,
+        port: Optional[int] = None,
+        authkey: Optional[bytes] = None,
+        num_cpus: float = 0.0,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        os.makedirs(session_dir, exist_ok=True)
+        self.session_dir = session_dir
+        if port is None:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+        self.port = int(port)
+        self.authkey = authkey or secrets.token_bytes(16)
+        self.num_cpus = num_cpus
+        self._env = dict(env or {})
+        self._lock = threading.Lock()
+        self._stopping = False
+        self._gen = 0
+        self._env.setdefault("PYTHONPATH", _pinned_pythonpath())
+        #: Completed restart count (a kill that came back).
+        self.restarts = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self._start_head()
+        self._watcher = threading.Thread(
+            target=self._watch, name="head-supervisor", daemon=True
+        )
+        self._watcher.start()
+
+    @property
+    def tcp_address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    @property
+    def address(self) -> str:
+        """``ray_tpu.init(address=...)`` form (host:port?authkey)."""
+        return f"{self.tcp_address}?{self.authkey.hex()}"
+
+    def _start_head(self) -> None:
+        self._gen += 1
+        log_path = os.path.join(self.session_dir, f"head-{self._gen}.err")
+        with open(log_path, "wb") as log:
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "ray_tpu._private.head_main",
+                    "--session-dir", self.session_dir,
+                    "--tcp-port", str(self.port),
+                    "--authkey", self.authkey.hex(),
+                    "--num-cpus", str(self.num_cpus),
+                ],
+                env={**os.environ, **self._env},
+                stdout=subprocess.DEVNULL,
+                stderr=log,
+            )
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                with open(log_path, "rb") as f:
+                    tail = f.read()[-2000:].decode(errors="replace")
+                raise RuntimeError(f"head exited during startup: {tail}")
+            try:
+                with open(log_path, "rb") as f:
+                    if b"head up" in f.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            raise TimeoutError("head did not come up within 30s")
+        self.proc = proc
+
+    def _watch(self) -> None:
+        while True:
+            proc = self.proc
+            if proc is None:
+                return
+            proc.wait()
+            with self._lock:
+                if self._stopping:
+                    return
+            # Relaunch on the same address/session: persisted tables
+            # restore; everyone reconnects. A port still draining from
+            # the old process retries briefly.
+            for attempt in range(5):
+                try:
+                    self._start_head()
+                    break
+                except (RuntimeError, TimeoutError, OSError):
+                    if attempt == 4:
+                        return  # supervisor gives up: head stays dead
+                    time.sleep(0.5)
+            with self._lock:
+                if self._stopping:
+                    return
+                self.restarts += 1
+
+    def kill(self) -> None:
+        """SIGKILL the current head (the supervisor restarts it)."""
+        proc = self.proc
+        if proc is not None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+    def wait_restarted(self, n: int, timeout: float = 60.0) -> bool:
+        """Block until at least ``n`` restarts completed."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if self.restarts >= n:
+                    return True
+            time.sleep(0.1)
+        return False
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopping = True
+        proc = self.proc
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
 class DaemonCluster:
     """Head + real node-daemon subprocesses over the TCP control plane."""
 
@@ -89,12 +250,26 @@ class DaemonCluster:
         self._daemons: List[subprocess.Popen] = []
 
     @classmethod
-    def attach(cls) -> "DaemonCluster":
+    def attach(
+        cls,
+        head_address: Optional[str] = None,
+        authkey: Optional[bytes] = None,
+    ) -> "DaemonCluster":
         """Attach to the ALREADY-initialized TCP-enabled head instead of
         starting one (``__init__`` refuses a live session). Daemons
         added through the attached handle are owned by it — callers
         shut them down via ``kill_node``, not ``shutdown`` (the session
-        belongs to whoever initialized it)."""
+        belongs to whoever initialized it).
+
+        Pass ``head_address``/``authkey`` explicitly to attach to an
+        EXTERNAL head (e.g. a ``SupervisedHead``) this process joined
+        via ``init(address=...)`` — there is no in-process node then."""
+        if head_address is not None and authkey is not None:
+            self = cls.__new__(cls)
+            self.head_address = head_address
+            self.authkey = authkey
+            self._daemons = []
+            return self
         from ._private.worker import _global
 
         if _global.node is None or not _global.node.tcp_address:
@@ -124,6 +299,7 @@ class DaemonCluster:
             res["TPU"] = float(num_tpus)
         res.update(resources or {})
         before = len(ray_tpu.nodes())
+        env = {**os.environ, "PYTHONPATH": _pinned_pythonpath()}
         proc = subprocess.Popen(
             [
                 sys.executable,
@@ -140,6 +316,7 @@ class DaemonCluster:
                 "--transfer-host",
                 "127.0.0.1",
             ],
+            env=env,
             stderr=subprocess.PIPE,
             stdout=subprocess.PIPE,
         )

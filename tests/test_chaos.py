@@ -267,6 +267,99 @@ def test_ref_flush_lost_batch_counted_after_max_attempts():
     t.stop()
 
 
+def test_done_batcher_retransmits_and_renumbers_on_reconnect():
+    """Head failover, worker half: task_done batches are at-least-once
+    (seq + ack + retransmit), and a reconnect renumbers the unacked
+    tail from 1 — the restarted head's per-conn sequencer starts over,
+    so the old numbering would read as a permanent gap."""
+    from ray_tpu._private.worker_main import _DoneBatcher
+
+    class _Client:
+        def __init__(self):
+            from ray_tpu._private.ids import WorkerID
+
+            self.worker_id = WorkerID.from_random()
+            self.conn = _FakeConn()
+            self.sent = []
+            self.done_ack = None
+            self._conn_gen = 0
+
+        def send(self, msg):
+            self.sent.append(msg)
+
+        def conn_failover_pending(self):
+            return True
+
+    c = _Client()
+    b = _DoneBatcher(c)
+    b._thread = object()  # keep the background flush loop out of this test
+    assert c.done_ack == b.ack  # ack push wired at construction
+
+    def _batches():
+        return [m for m in c.sent if m.get("items")]
+
+    b.add({"task_id": b"t1", "name": "x", "results": [], "error": None})
+    b.flush()
+    assert [m["seq"] for m in _batches()] == [1]
+    # Unacked past the retransmit age: the next flush resends the SAME
+    # batch (same seq — the head sequencer dedups), WITHOUT the
+    # flight-recorder piggyback (no double ingest).
+    with b._lock:
+        b._unacked[1][1] -= _DoneBatcher._RETRANSMIT_S + 1
+        assert "events" not in b._unacked[1][0]
+    b.flush()
+    assert [m["seq"] for m in _batches()] == [1, 1]
+    # Second batch, first acked: a reconnect renumbers the unacked
+    # tail from 1 (order preserved) and retransmits immediately.
+    b.add({"task_id": b"t2", "name": "y", "results": [], "error": None})
+    b.flush()
+    b.ack(1)
+    c.sent = []
+    c._conn_gen = 1  # the client swapped to a fresh connection
+    b.on_reconnect()
+    resent = _batches()
+    assert [m["seq"] for m in resent] == [1]
+    assert resent[0]["items"][0]["task_id"] == b"t2"
+    b.ack(1)
+    with b._lock:
+        assert not b._unacked
+
+
+def test_owner_tracker_reconnect_renumbers_and_readvertises():
+    """Head failover, owner half: on_reconnect renumbers unacked
+    ref_flush batches, re-dirties borrowed/fallback refs so their
+    edges re-send, and returns the owned-object reconcile payload
+    (oid -> live borrowers) for the recovery window."""
+    from ray_tpu._private.object_plane.owner_refs import OwnerRefTracker
+
+    c = _FakeClient()
+    t = OwnerRefTracker(c)
+    me = c.worker_id.binary()
+    owned, borrowed, other = b"o" * 16, b"b" * 16, b"x" * 16
+    t.incr(owned, me)
+    t.mark_advertised(owned)
+    t.apply_borrow_update(b"peer1", [owned], None)  # live borrow edge
+    t.incr(borrowed, other)
+    t.flush(c)  # advertises `borrowed` via badd, seq 1 (never acked)
+    assert [m["seq"] for m in c.conn.sent] == [1]
+
+    c._conn_gen = 1  # the client swapped to a fresh connection
+    recon = t.on_reconnect()
+    assert recon == {owned: [b"peer1"]}
+    with t._lock:
+        assert list(t._unacked) == [1]
+        assert t._unacked[1][1] == 0.0  # due immediately
+        assert borrowed in t._dirty  # re-advertises on next flush
+    c.conn.sent = []
+    t.flush(c)
+    # New batch carries the re-advertised borrow edge; the renumbered
+    # unacked batch retransmits alongside it.
+    new = [m for m in c.conn.sent if (other, borrowed) in m.get("badd", [])]
+    assert new, f"borrow edge not re-advertised: {c.conn.sent}"
+    assert any(m["seq"] == 1 for m in c.conn.sent if m is not new[0])
+    t.stop()
+
+
 def test_dead_borrower_late_add_ignored():
     """borrower_died sweep racing a delayed/reordered head→owner relay:
     the late add must not resurrect a borrow edge nothing will ever

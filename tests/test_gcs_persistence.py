@@ -28,7 +28,8 @@ def _free_port() -> int:
     return port
 
 
-def _spawn_head(session_dir: str, port: int, authkey: str) -> subprocess.Popen:
+def _spawn_head(session_dir: str, port: int, authkey: str,
+                extra_env: dict = None) -> subprocess.Popen:
     proc = subprocess.Popen(
         [
             sys.executable, "-m", "ray_tpu._private.head_main",
@@ -37,7 +38,7 @@ def _spawn_head(session_dir: str, port: int, authkey: str) -> subprocess.Popen:
             "--authkey", authkey,
             "--num-cpus", "0",
         ],
-        env={**os.environ, "PYTHONPATH": REPO},
+        env={**os.environ, "PYTHONPATH": REPO, **(extra_env or {})},
         stderr=subprocess.PIPE,
     )
     # Wait for the listening line.
@@ -174,6 +175,106 @@ def test_head_restart_recovers_state(tmp_path):
                 p.kill()
             except Exception:
                 pass
+
+
+def test_mid_persist_kill_loads_last_complete_generation(tmp_path):
+    """ISSUE 9 satellite: a head killed MID persist tick — new table
+    files on disk, manifest not yet swapped (chaos kill point
+    gcs.mid_persist) — must never leave a torn snapshot: the restarted
+    head loads the last COMPLETE generation (the manifest-last atomic
+    rename ordering is the crash-consistency contract)."""
+    import pickle
+
+    session_dir = str(tmp_path / "headsess")
+    port = _free_port()
+    authkey = secrets.token_bytes(16).hex()
+    address = f"127.0.0.1:{port}?{authkey}"
+
+    # The 1st dirty persist tick (marker A) completes; the 2nd (marker
+    # B) dies between the table-file writes and the manifest swap.
+    head = _spawn_head(
+        session_dir, port, authkey,
+        extra_env={
+            "RAY_TPU_chaos_spec": "kill:gcs.mid_persist=2?role=head",
+            "RAY_TPU_chaos_seed": "1",
+        },
+    )
+    state_dir = os.path.join(session_dir, "gcs_state.d")
+
+    def manifest_kv_file():
+        try:
+            with open(os.path.join(state_dir, "manifest.pkl"), "rb") as f:
+                return pickle.load(f).get("kv")
+        except (OSError, pickle.PickleError):
+            return None
+
+    try:
+        _run_driver(
+            """
+import sys
+import ray_tpu
+from ray_tpu._private.worker import global_client
+ray_tpu.init(address=sys.argv[1])
+global_client().kv_put(b"marker_a", b"1")
+print("A-OK")
+""",
+            address,
+        )
+        # Wait for tick 1 (marker_a) to land in the manifest.
+        deadline = time.time() + 20
+        while time.time() < deadline and manifest_kv_file() is None:
+            time.sleep(0.1)
+        gen1_kv = manifest_kv_file()
+        assert gen1_kv is not None, "first persist never landed"
+
+        # marker_b dirties the kv table; the persist tick for it dies
+        # at the kill point (after table files, before manifest swap).
+        subprocess.run(
+            [sys.executable, "-c", """
+import sys
+import ray_tpu
+from ray_tpu._private.worker import global_client
+ray_tpu.init(address=sys.argv[1])
+global_client().kv_put(b"marker_b", b"1")
+""", address],
+            env={**os.environ, "PYTHONPATH": REPO},
+            timeout=60,
+        )
+        try:
+            head.wait(timeout=30)  # the kill point fires on that tick
+        except subprocess.TimeoutExpired:
+            raise AssertionError("head survived the mid-persist kill point")
+        # Torn state on disk: a NEWER kv table file exists but the
+        # manifest still names the last complete generation.
+        assert manifest_kv_file() == gen1_kv
+        newer = [
+            f for f in os.listdir(state_dir)
+            if f.startswith("kv.") and not f.endswith(".tmp")
+            and f != gen1_kv
+        ]
+        assert newer, "kill point fired before the torn window"
+
+        head = _spawn_head(session_dir, port, authkey)
+        out = _run_driver(
+            """
+import sys
+import ray_tpu
+from ray_tpu._private.worker import global_client
+ray_tpu.init(address=sys.argv[1])
+c = global_client()
+assert c.kv_get(b"marker_a") == b"1", "complete generation lost"
+print("RESTORED", c.kv_get(b"marker_b"))
+""",
+            address,
+        )
+        # marker_a (last complete cut) MUST be there; marker_b belongs
+        # to the torn tick and must read as cleanly absent, not corrupt.
+        assert "RESTORED None" in out
+    finally:
+        try:
+            head.kill()
+        except Exception:
+            pass
 
 
 def test_segmented_persistence_rewrites_only_dirty_tables(tmp_path):
