@@ -3,9 +3,10 @@
 # build, bytecode lint, stress binaries, full suite).
 
 .PHONY: ci native lint test obs-smoke envelope-smoke chaos-smoke \
-	failover-smoke stress clean
+	failover-smoke pressure-smoke stress clean
 
-ci: native lint test obs-smoke envelope-smoke chaos-smoke failover-smoke
+ci: native lint test obs-smoke envelope-smoke chaos-smoke failover-smoke \
+	pressure-smoke
 
 native:
 	$(MAKE) -C native
@@ -73,6 +74,22 @@ failover-smoke:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
 		--only head_failover --failover-smoke \
 		--out /tmp/ray_tpu_failover_smoke.json
+
+# Memory-pressure soak, scaled down (a 32 MiB broadcast chunk train to
+# 8 real daemon nodes concurrent with hundreds of small gets, under a
+# 48 MiB pool and a 12 MiB in-flight pull budget, then seeded storage
+# chaos: spill IO errors, disk-full, truncated spill files). Asserts
+# bounded small-get p99 (no starvation), in-flight pull bytes <= budget
+# (from PULL_ACTIVATE flight-recorder events), zero wedged gets, no
+# leaked pool bytes, and that every injected storage fault ends in
+# backpressure / OutOfMemoryError / lineage reconstruction. A host
+# without the TCP control plane records pressure_soak_skipped —
+# counted, never silent. The full 1 GiB / 8-node soak:
+#   python -m ray_tpu._private.ray_perf --only pressure_soak
+pressure-smoke:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
+		--only pressure_soak --pressure-smoke \
+		--out /tmp/ray_tpu_pressure_smoke.json
 
 stress:
 	$(MAKE) -C native stress-asan

@@ -35,6 +35,13 @@ comma-separated entries):
     kill:<point>=<nth>[?role=<role>]        kill on the nth hit
     kill:<point>=p:<prob>[?role=<role>]     probabilistic kill
 
+    <fault>:<point>=<nth> | p:<prob>        storage-plane fault points
+        fault ∈ io_error | disk_full | truncate — consulted by the
+        spill pipeline via :func:`fault_point` (io_error:spill_write,
+        disk_full:spill, truncate:spill_file): instead of killing the
+        process, the hook site injects the named failure (EIO, ENOSPC,
+        a truncated file) and the degradation ladder must absorb it.
+
 Determinism: every rule draws from its own ``random.Random`` seeded by
 sha256(seed, rule-text) — the nth decision of a rule is a pure function
 of (seed, rule, n), so a failed run replays with one env var
@@ -62,8 +69,12 @@ __all__ = [
     "refresh",
     "active",
     "kill_point",
+    "fault_point",
     "mtype_of",
 ]
+
+#: Rule-name prefixes parsed as storage fault points (vs message rules).
+_FAULT_PREFIXES = ("io_error:", "disk_full:", "truncate:")
 
 
 # ------------------------------------------------------------------ backoff
@@ -285,6 +296,11 @@ class FaultSchedule:
         self._lock = threading.Lock()
         self._msg_rules: Dict[str, List[_MsgRule]] = {}
         self._kill_rules: Dict[str, List[_KillRule]] = {}
+        # Storage fault points, keyed by full rule name
+        # ("io_error:spill_write") — same nth/probability grammar as
+        # kill rules, but the hook site injects a failure instead of
+        # dying (_KillRule is reused as the decision record).
+        self._fault_rules: Dict[str, List[_KillRule]] = {}
         self.stats: Dict[str, int] = {}
         self._role = current_role()
         for i, entry in enumerate(e for e in spec.split(",") if e.strip()):
@@ -321,6 +337,13 @@ class FaultSchedule:
             else:
                 rule = _KillRule(point, int(value), None, role, key, rng)
             self._kill_rules.setdefault(point, []).append(rule)
+            return
+        if name.startswith(_FAULT_PREFIXES):
+            if value.startswith("p:"):
+                rule = _KillRule(name, None, float(value[2:]), role, key, rng)
+            else:
+                rule = _KillRule(name, int(value), None, role, key, rng)
+            self._fault_rules.setdefault(name, []).append(rule)
             return
         limit = None
         if "@" in value:
@@ -455,6 +478,40 @@ class FaultSchedule:
     def _kill(self) -> None:  # monkeypatched by tests
         os._exit(143)
 
+    # --------------------------------------------------------- fault points
+
+    def maybe_fault(self, point: str) -> bool:
+        """Storage-plane fault decision for one hit of ``point`` (e.g.
+        "io_error:spill_write"). True = the hook site must inject the
+        named failure; the decision stream is deterministic under the
+        schedule's seed, and every injected fault records a CHAOS
+        event so a red run stays attributable."""
+        rules = self._fault_rules.get(point)
+        if not rules:
+            return False
+        with self._lock:
+            fire = None
+            for rule in rules:
+                if rule.role is not None and rule.role != self._role:
+                    continue
+                rule.hits += 1
+                if rule.nth is not None:
+                    if rule.hits == rule.nth:
+                        fire = rule
+                        break
+                elif rule.rng.random() < (rule.p or 0.0):
+                    fire = rule
+                    break
+            if fire is None:
+                return False
+            fire.fired += 1
+            self.stats[point] = self.stats.get(point, 0) + 1
+        if _events.enabled():
+            _events.record(
+                _events.CHAOS, point, "FAULT", {"rule": fire.key}
+            )
+        return True
+
     # ----------------------------------------------------------- connect hook
 
     def on_connect(self, address: str) -> None:
@@ -521,6 +578,15 @@ def kill_point(name: str) -> None:
     sched = _active
     if sched is not None:
         sched.maybe_kill(name)
+
+
+def fault_point(name: str) -> bool:
+    """Named storage-plane fault hook: True when the hook site must
+    inject the named failure (one module-global read when chaos is
+    off). See the spec grammar — io_error:spill_write, disk_full:spill,
+    truncate:spill_file."""
+    sched = _active
+    return sched is not None and sched.maybe_fault(name)
 
 
 def mtype_of(msg: Any) -> Optional[str]:

@@ -124,6 +124,12 @@ class CoreClient:
         # other nodes are pulled through the transfer plane.
         self.node_id: Optional[bytes] = reply.get("node_id")
         self._fetcher = ObjectFetcher(self.store, authkey)
+        # Admission control over the transfer plane (pull_manager.h):
+        # pulls queue get > wait > task-args under a bounded in-flight
+        # byte budget so bulk broadcasts can't starve small gets.
+        from .object_plane.pull_manager import PullManager
+
+        self._pull_manager = PullManager(self._fetcher, store=self.store)
         self._registered_functions: set = set()
         self._fn_lock = threading.Lock()
         # Direct actor-call path (reference: actor calls bypass raylets,
@@ -1247,7 +1253,8 @@ class CoreClient:
         return fields
 
     def _materialize(self, reply: Dict[str, Any], oid: ObjectID,
-                     _retried: bool = False, packed: bool = False) -> Any:
+                     _retried: bool = False, packed: bool = False,
+                     timeout: Optional[float] = None) -> Any:
         from ..exceptions import ObjectLostError
 
         if reply.get("status") == "FAILED":
@@ -1265,18 +1272,31 @@ class CoreClient:
         if spilled is not None and not self.store.contains(oid):
             # Restore rung of the memory-pressure ladder: the object was
             # spilled to disk under pool pressure. Same-host: read the
-            # file directly; cross-node: fall through to the transfer
-            # plane (the owner's transfer server restores from its
-            # spill dir).
+            # file directly (header + checksum validated — truncated
+            # bytes are never returned); cross-node: fall through to the
+            # transfer plane (the owner's transfer server restores from
+            # its spill dir).
+            from .object_store import SpillCorruptionError, read_spill_file
+
             try:
-                with open(spilled, "rb") as f:
-                    data = f.read()
+                data = read_spill_file(spilled)
                 return data if packed else serialization.unpack(data)
+            except SpillCorruptionError:
+                # Bad file: tell the head so the entry resolves LOST
+                # (reconstruct from lineage) instead of every future get
+                # re-reading garbage; fall through to the other copies.
+                try:
+                    self.send_reliable(
+                        {"type": "spill_corrupt", "object_id": oid.binary()}
+                    )
+                except (ConnectionLost, RayTpuError):
+                    pass
             except OSError:
                 pass
         # Cross-node: the object's primary copy lives on another node —
-        # pull it into the local store first (reference: raylet
-        # PullManager fetching via the object directory).
+        # pull it into the local store first, through the admission-
+        # controlled pull manager (reference: raylet PullManager
+        # fetching via the object directory).
         owner_node = reply.get("node_id")
         if (
             owner_node is not None
@@ -1284,7 +1304,12 @@ class CoreClient:
             and not self.store.contains(oid)
         ):
             addr = reply.get("transfer_addr")
-            if not addr or not self._fetcher.pull(oid, addr):
+            # The caller's remaining get budget covers BOTH the
+            # admission queue wait and the chunk fetch — a pull parked
+            # behind a saturated budget must not fail a patient get.
+            if not addr or not self._pull_manager.pull(
+                oid, addr, size=reply.get("size") or 0, timeout=timeout
+            ):
                 raise ObjectLostError(
                     f"object {oid.hex()} on node "
                     f"{owner_node.hex()[:8]} could not be fetched"
@@ -1309,7 +1334,7 @@ class CoreClient:
                     {"type": "get_object", "object_id": oid.binary()}
                 )
                 return self._materialize(fresh, oid, _retried=True,
-                                         packed=packed)
+                                         packed=packed, timeout=timeout)
             # Directory says READY but the data is gone (evicted).
             raise ObjectLostError(
                 f"object {oid.hex()} missing from the local store (evicted)"
@@ -1328,7 +1353,8 @@ class CoreClient:
         oid = ref.id()
         for _ in range(3):
             try:
-                return self._materialize(reply, oid, packed=packed)
+                return self._materialize(reply, oid, packed=packed,
+                                         timeout=remaining)
             except ObjectLostError:
                 spec = self._lineage.get(oid.binary())
                 if spec is None:
@@ -1338,7 +1364,8 @@ class CoreClient:
                     {"type": "get_object", "object_id": oid.binary()},
                     timeout=remaining,
                 )
-        return self._materialize(reply, oid, packed=packed)
+        return self._materialize(reply, oid, packed=packed,
+                                 timeout=remaining)
 
     def _resolve_direct_entry(
         self, ref: ObjectRef, entry, remaining: Optional[float]
@@ -1622,6 +1649,12 @@ class CoreClient:
         with self._direct_lock:
             for oid in ids:
                 self._direct_results.pop(oid, None)
+        # Queued pulls for a freed object cancel now — their budget
+        # share activates the next request instead of fetching data
+        # nobody can reference (reference: pull cancellation on
+        # ref-drop, pull_manager.h).
+        for oid in ids:
+            self._pull_manager.cancel(oid)
         self._wait_prune(ids)
         # Explicit free: drop tracker state so the instances still alive
         # can't emit retractions for entries already gone.
@@ -1712,6 +1745,7 @@ class CoreClient:
         rp = getattr(self, "_raylet_peer", None)
         if rp is not None:
             rp.close()
+        self._pull_manager.close()
         self._fetcher.close()
         self.store.close()
 

@@ -98,6 +98,18 @@ _DEFAULTS: Dict[str, Any] = {
     # Pool-utilization fraction that triggers background spilling of
     # cold sealed objects (reference: object_spilling_threshold).
     "object_spilling_threshold": 0.8,
+    # Pull-manager admission control (reference: pull_manager.h — get >
+    # wait > task-args priority classes under a bounded in-flight
+    # budget). Total bytes of concurrently-active pulls per process;
+    # 0 = auto (a quarter of the node pool, floor 4 transfer chunks).
+    # Requests over budget queue by (class, FIFO) and activate as
+    # completed/failed/cancelled pulls release budget.
+    "pull_in_flight_bytes": 0,
+    # How long a put (or task-arg inlining) blocks on a full pool
+    # waiting for the spill ladder to free space before falling back
+    # to per-object segments / raising OutOfMemoryError. Backpressure,
+    # not a cliff: the spill rung gets this long to make room.
+    "put_backpressure_timeout_s": 10.0,
     # Memory monitor (reference: memory_monitor.h:52 + the retriable-
     # FIFO worker killing policy): sample host memory every refresh; at
     # or above the usage threshold, kill the newest running retriable

@@ -567,6 +567,14 @@ class GcsServer:
             session_dir, "spill"
         )
         os.environ["RAY_TPU_SPILL_DIR"] = self.spill_dir
+        # Disk trouble (ENOSPC, EIO after retries) parks the spiller
+        # until this deadline instead of hot-looping a failing disk;
+        # objects stay resident and puts ride the backpressure rung.
+        # One pass at a time: the monitor thread and the synchronous
+        # spill_tick hook must not race each other onto the same
+        # candidates (they'd double-spill and collide on writes).
+        self._spill_blocked_until = 0.0
+        self._spill_pass_lock = threading.Lock()
         self._spill_thread = threading.Thread(
             target=self._spill_loop, name="gcs-spill", daemon=True
         )
@@ -1066,10 +1074,13 @@ class GcsServer:
             w = self.workers.get(wid)
             r = msg["result"]
             entry = self.objects.setdefault(r["object_id"], ObjectEntry())
+            was_ready = entry.status == READY
             entry.status = READY
             entry.inline = r.get("inline")
             entry.segment = r.get("segment")
             entry.size = r.get("size", 0)
+            if not was_ready:  # fresh seal (not a dup) supersedes spill
+                _drop_spill_file(entry)
             entry.node_id = w.node_id if w else None
             entry.last_access = time.time()
             for child in r.get("children", []):
@@ -1311,10 +1322,13 @@ class GcsServer:
                 entry.status = FAILED
                 entry.error = error_blob
             else:
+                was_ready = entry.status == READY
                 entry.status = READY
                 entry.inline = r.get("inline")
                 entry.segment = r.get("segment")
                 entry.size = r.get("size", 0)
+                if not was_ready:  # fresh seal (not a dup) supersedes spill
+                    _drop_spill_file(entry)
                 entry.node_id = w.node_id if w else None
                 entry.last_access = time.time()
                 for child in r.get("children", []):
@@ -1405,6 +1419,7 @@ class GcsServer:
     def _h_put_object(self, state, msg):
         with self._lock:
             entry = self.objects.setdefault(msg["object_id"], ObjectEntry())
+            was_ready = entry.status == READY
             entry.status = READY
             # Born OWNED by the putter (object plane): the owner keeps
             # the authoritative refcount in its own process and sends
@@ -1414,10 +1429,28 @@ class GcsServer:
             if cid is not None:
                 entry.owner = cid
                 entry.had_holder = True
-            entry.inline = msg.get("inline")
-            entry.segment = msg.get("segment")
-            entry.size = msg.get("size", 0)
+            if not (was_ready and entry.spilled_path is not None):
+                # Skip the data-field overwrite on a DUPLICATE delivery
+                # of an already-spilled object: the replayed message's
+                # segment name points at the pool copy the spill
+                # deleted, and re-pointing the entry there would defeat
+                # the corrupt-spill -> LOST transition (which gates on
+                # segment is None).
+                entry.inline = msg.get("inline")
+                entry.segment = msg.get("segment")
+                entry.size = msg.get("size", 0)
             entry.last_access = time.time()
+            if not was_ready:
+                # A genuinely fresh seal (PENDING/LOST -> READY, e.g. a
+                # reconstruction replacing a corrupt spill file)
+                # supersedes any stale spill copy: reads must hit the
+                # new bytes, and the old file unlinks now, not never.
+                # A DUPLICATE delivery (put_object rides the
+                # at-least-once request path across failovers) must NOT
+                # touch the spill copy — after a spill it is the only
+                # bytes left, and the replayed message's segment name
+                # may no longer be backed by the pool.
+                _drop_spill_file(entry)
             if entry.segment is not None:
                 nid = state.get("obj_node_id")
                 entry.node_id = NodeID(nid) if nid else self.head_node.node_id
@@ -3251,58 +3284,108 @@ class GcsServer:
         while not self._shutdown:
             time.sleep(0.2)
             try:
-                st = pool.stats()
-            except Exception:  # noqa: BLE001
+                self._spill_pass()
+            except Exception:  # noqa: BLE001 - store closed (shutdown)
                 return
-            cap = st.get("pool_size") or st.get("arena_size") or 0
-            if not cap:
-                continue
-            frac = st["bytes_in_use"] / cap
-            threshold = RayConfig.object_spilling_threshold
-            if frac < threshold:
-                continue
-            target = max(0.0, threshold - 0.1)
-            to_free = int((frac - target) * cap)
-            with self._lock:
-                head = self.head_node.node_id
-                candidates = sorted(
-                    (
-                        (e.last_access, oid, e)
-                        for oid, e in self.objects.items()
-                        if e.status == READY
-                        and e.segment == "pool"
-                        and e.spilled_path is None
-                        and e.task_pins == 0
-                        and e.node_id == head
-                    ),
-                    key=lambda t: t[0],
-                )
-            freed = 0
-            for _, oid, entry in candidates:
-                if freed >= to_free:
-                    break
-                freed += self._spill_one(oid, entry)
+
+    def _spill_pass(self) -> int:
+        """One spill tick: returns bytes freed from the pool. Split out
+        of the monitor loop so tests (and the `spill_tick` control
+        message) can drive spilling deterministically instead of
+        sleep-polling the 0.2s monitor cadence. Serialized: concurrent
+        passes would select the same LRU candidates and race their
+        writes."""
+        pool = getattr(self._store, "_pool", None)
+        if pool is None or self._shutdown:
+            return 0
+        with self._spill_pass_lock:
+            return self._spill_pass_locked(pool)
+
+    def _spill_pass_locked(self, pool) -> int:
+        if time.monotonic() < self._spill_blocked_until:
+            return 0  # disk trouble: parked, objects stay resident
+        st = pool.stats()
+        cap = st.get("pool_size") or st.get("arena_size") or 0
+        if not cap:
+            return 0
+        frac = st["bytes_in_use"] / cap
+        threshold = RayConfig.object_spilling_threshold
+        if frac < threshold:
+            return 0
+        target = max(0.0, threshold - 0.1)
+        to_free = int((frac - target) * cap)
+        with self._lock:
+            head = self.head_node.node_id
+            candidates = sorted(
+                (
+                    (e.last_access, oid, e)
+                    for oid, e in self.objects.items()
+                    if e.status == READY
+                    and e.segment == "pool"
+                    and e.spilled_path is None
+                    and e.task_pins == 0
+                    and e.node_id == head
+                ),
+                key=lambda t: t[0],
+            )
+        freed = 0
+        for _, oid, entry in candidates:
+            if freed >= to_free:
+                break
+            freed += self._spill_one(oid, entry)
+            if time.monotonic() < self._spill_blocked_until:
+                # A write just failed through its whole retry budget:
+                # stop the pass NOW — retrying the remaining candidates
+                # against the same sick disk would turn one park into
+                # candidates × retry-budget of stall.
+                break
+        return freed
+
+    def _h_spill_tick(self, state, msg):
+        """Run one synchronous spill pass (testing/ops hook): makes
+        spill-dependent tests deterministic — trigger, don't poll.
+        Deliberately ON the dispatch thread (unlike spill_corrupt
+        validation): the tests need the pass complete when the reply
+        lands, and callers are test harnesses, not production cadence —
+        the stall is the caller's to own."""
+        freed = self._spill_pass()
+        state["peer"].reply(msg, ok=True, freed=freed)
 
     def _spill_one(self, oid: bytes, entry: ObjectEntry) -> int:
         """Write one sealed object to the spill dir, then free its pool
         copy. Ordering matters: the file + directory update land before
         the delete so a concurrent directory lookup always finds one
         valid copy (a get reply already in flight falls back to a
-        re-request on store miss — client._materialize)."""
-        from .object_store import spill_path
+        re-request on store miss — client._materialize).
+
+        The write itself is crash-atomic with a validated header
+        (object_store.write_spill_file); transient IO errors and
+        disk-full retry on the shared backoff policy, and a write that
+        still fails DEGRADES — the object stays resident, the spiller
+        parks briefly, and puts feel backpressure — instead of crashing
+        the daemon or silently dropping the copy."""
+        from .object_store import write_spill_file
 
         raw = self._store.get_raw(ObjectID(oid))
         if raw is None:
             return 0
         try:
-            os.makedirs(self.spill_dir, exist_ok=True)
-            path = spill_path(self.spill_dir, ObjectID(oid))
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(raw)
-            os.replace(tmp, path)
+            path = _chaos.retry_call(
+                lambda: write_spill_file(self.spill_dir, ObjectID(oid), raw),
+                retry_on=(OSError,),
+                backoff=_chaos.Backoff(
+                    base_s=0.02, cap_s=0.25, budget_s=1.0
+                ),
+            )
             n = len(raw)
-        except OSError:
+        except OSError as e:
+            if _events.enabled():
+                _events.record(
+                    _events.REFS, ObjectID(oid).hex()[:12], "SPILL_FAIL",
+                    {"error": f"{type(e).__name__}: {e}",
+                     "errno": getattr(e, "errno", None)},
+                )
+            self._spill_blocked_until = time.monotonic() + 2.0
             return 0
         finally:
             self._store.release_raw(ObjectID(oid))
@@ -3328,6 +3411,53 @@ class GcsServer:
                 )
         self._store.delete(ObjectID(oid))
         return n
+
+    def _h_spill_corrupt(self, state, msg):
+        """A reader found a spill file that fails header/checksum
+        validation. Re-validate (the report may be stale — the entry
+        may have re-sealed since), then drop the bad file and answer
+        LOST when it was the only copy, so gets resolve into lineage
+        reconstruction instead of re-reading garbage forever. The
+        checksum pass streams the whole file, so it runs on its own
+        short-lived thread — never on the dispatch loop."""
+        oid = msg["object_id"]
+        with self._lock:
+            entry = self.objects.get(oid)
+            path = entry.spilled_path if entry is not None else None
+        if path is None:
+            return
+        threading.Thread(
+            target=self._validate_spill_report, args=(oid, path),
+            name="gcs-spill-validate", daemon=True,
+        ).start()
+
+    def _validate_spill_report(self, oid: bytes, path: str) -> None:
+        from .object_store import SpillCorruptionError, verify_spill_file
+
+        try:
+            verify_spill_file(path)
+            return  # validates fine now: stale/racy report
+        except (OSError, SpillCorruptionError):
+            pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        with self._lock:
+            entry = self.objects.get(oid)
+            if entry is None or entry.spilled_path != path:
+                return
+            entry.spilled_path = None
+            if entry.segment is None and entry.inline is None:
+                entry.status = LOST
+                self._notify_object(entry)
+            self._version += 1
+            self._table_versions["objects"] += 1
+        if _events.enabled():
+            _events.record(
+                _events.REFS, ObjectID(oid).hex()[:12], "SPILL_FAIL",
+                {"error": "corrupt spill file dropped", "lost": True},
+            )
 
     def _memory_usage_fraction(self) -> Optional[float]:
         test_file = RayConfig.testing_memory_usage_file
@@ -3695,17 +3825,23 @@ class GcsServer:
             if node.conn is not None:
                 self._daemon_conn_count = max(0, self._daemon_conn_count - 1)
             node.conn = None
-            # Objects whose primary copy lived on the dead node are LOST;
-            # owners reconstruct them from lineage on the next get
-            # (reference: object_recovery_manager.h:41).
+            # Objects whose primary copy lived on the dead node are LOST
+            # — including copies spilled to the node's local disk (the
+            # file died with the host); owners reconstruct them from
+            # lineage on the next get (reference:
+            # object_recovery_manager.h:41).
             for entry in self.objects.values():
                 if (
                     entry.status == READY
-                    and entry.segment is not None
+                    and (
+                        entry.segment is not None
+                        or entry.spilled_path is not None
+                    )
                     and entry.node_id is not None
                     and entry.node_id.binary() == nid
                 ):
                     entry.status = LOST
+                    entry.spilled_path = None
                     self._notify_object(entry)
             dead_workers = [
                 w
@@ -4576,6 +4712,18 @@ class GcsServer:
         for oid in segs:
             self._store.delete(oid)
         self._store.close()
+
+
+def _drop_spill_file(entry: "ObjectEntry") -> None:
+    """Clear (and unlink) an entry's superseded spill copy: a fresh
+    seal replaces the bytes, and the old file would otherwise sit in
+    the spill dir unreferenced for the session lifetime."""
+    if entry.spilled_path:
+        try:
+            os.unlink(entry.spilled_path)
+        except OSError:
+            pass
+    entry.spilled_path = None
 
 
 def sort_oom_victims(victims: List["WorkerHandle"]) -> List["WorkerHandle"]:

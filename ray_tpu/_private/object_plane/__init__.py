@@ -17,6 +17,11 @@ that previously rode the head's single dispatch loop:
   (the owner's authoritative count draining to zero, borrow edges
   opening/closing, owner death) are batched to the head.
 
+- :mod:`.pull_manager` — admission control over the transfer plane
+  (``pull_manager.h``): pulls queue by priority class (get > wait >
+  task-args) and activate under a bounded in-flight byte budget, so a
+  bulk broadcast cannot starve concurrent gets.
+
 - :mod:`.directory` — the head's object table sharded N ways, each
   shard with its own lock domain and flush queue. The dispatch loop
   only enqueues refcount batches; per-shard applier threads mutate
@@ -26,4 +31,4 @@ Ownerless objects (refs constructed without an owner, stream items,
 promoted entries after owner death) fall back to head-side holder
 sets, preserving the pre-plane semantics exactly.
 """
-from . import directory, owner_refs  # noqa: F401
+from . import directory, owner_refs, pull_manager  # noqa: F401

@@ -14,8 +14,12 @@ Objects are immutable once sealed, matching plasma semantics.
 """
 from __future__ import annotations
 
+import errno
 import os
+import struct
 import threading
+import time
+import zlib
 from multiprocessing import shared_memory, resource_tracker
 from typing import Any, Dict, Optional, Tuple
 
@@ -38,6 +42,126 @@ def spill_path(spill_dir: str, object_id: ObjectID) -> str:
     """Canonical on-disk location of a spilled object — shared by the
     GCS spiller and the transfer plane's restore fallback."""
     return os.path.join(spill_dir, object_id.hex() + ".bin")
+
+
+# ------------------------------------------------------------ spill files
+#
+# Spill files carry a validated header so a truncated or bit-flipped
+# file can never restore as silently wrong bytes (reference: the
+# external storage layer checksums spilled URLs,
+# local_object_manager.h:100). Writes are crash-atomic: temp file +
+# fsync + rename, so a daemon dying mid-spill leaves either no file or
+# a complete one — never a half-written path the directory points at.
+
+SPILL_MAGIC = b"RTPUSPL1"
+_SPILL_HDR = struct.Struct("<8sQI")  # magic, payload size, crc32
+SPILL_HEADER_BYTES = _SPILL_HDR.size
+
+
+class SpillCorruptionError(Exception):
+    """A spill file failed header/size/checksum validation. The object
+    is treated as LOST (reconstruct from lineage), never served."""
+
+
+def write_spill_file(spill_dir: str, object_id: ObjectID, raw) -> str:
+    """Atomically persist one sealed object's serialized bytes.
+
+    Chaos fault points (io_error:spill_write, disk_full:spill,
+    truncate:spill_file) inject the storage failures the degradation
+    ladder must absorb; the truncate fires AFTER the rename — the write
+    "succeeds" but the file is short, exactly what a torn disk leaves."""
+    from . import chaos as _chaos
+
+    if _chaos.fault_point("io_error:spill_write"):
+        raise OSError(errno.EIO, "chaos: injected spill write error")
+    if _chaos.fault_point("disk_full:spill"):
+        raise OSError(errno.ENOSPC, "chaos: injected disk full")
+    os.makedirs(spill_dir, exist_ok=True)
+    path = spill_path(spill_dir, object_id)
+    # Unique per writer: two threads spilling one object must not
+    # truncate each other's temp file mid-fsync (the rename would
+    # publish a short file as the only copy).
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    # No intermediate copy: crc32 and write() take the buffer directly
+    # (a 1 GiB spill must not allocate a second gigabyte).
+    view = raw if isinstance(raw, (bytes, bytearray, memoryview)) \
+        else memoryview(raw)
+    size = len(view)
+    header = _SPILL_HDR.pack(
+        SPILL_MAGIC, size, zlib.crc32(view) & 0xFFFFFFFF
+    )
+    try:
+        with open(tmp, "wb") as f:
+            f.write(header)
+            f.write(view)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if _chaos.fault_point("truncate:spill_file"):
+        with open(path, "r+b") as f:
+            f.truncate(SPILL_HEADER_BYTES + size // 2)
+    return path
+
+
+def spill_file_meta(path: str) -> Tuple[int, int]:
+    """(payload_size, crc32) from a spill file's header, validating the
+    magic and that the file length matches the recorded size — the
+    cheap check every restore makes before serving a single byte."""
+    with open(path, "rb") as f:
+        header = f.read(SPILL_HEADER_BYTES)
+    if len(header) < SPILL_HEADER_BYTES:
+        raise SpillCorruptionError(f"spill file truncated in header: {path}")
+    magic, size, crc = _SPILL_HDR.unpack(header)
+    if magic != SPILL_MAGIC:
+        raise SpillCorruptionError(f"spill file bad magic: {path}")
+    actual = os.path.getsize(path) - SPILL_HEADER_BYTES
+    if actual != size:
+        raise SpillCorruptionError(
+            f"spill file truncated: {path} ({actual} != {size} bytes)"
+        )
+    return size, crc
+
+
+def verify_spill_file(path: str) -> int:
+    """Validate a spill file's header, size, and checksum WITHOUT
+    materializing the payload (the crc streams in 1 MiB blocks) —
+    for servers validating files they are about to serve by chunk.
+    Returns the payload size; raises :class:`SpillCorruptionError`."""
+    size, crc = spill_file_meta(path)
+    running = 0
+    remaining = size
+    with open(path, "rb") as f:
+        f.seek(SPILL_HEADER_BYTES)
+        while remaining > 0:
+            block = f.read(min(1 << 20, remaining))
+            if not block:
+                raise SpillCorruptionError(f"spill file short read: {path}")
+            running = zlib.crc32(block, running)
+            remaining -= len(block)
+    if running & 0xFFFFFFFF != crc:
+        raise SpillCorruptionError(f"spill file checksum mismatch: {path}")
+    return size
+
+
+def read_spill_file(path: str) -> bytes:
+    """The validated payload of a spill file; raises
+    :class:`SpillCorruptionError` on any header/size/checksum mismatch
+    (and plain OSError when the file is gone)."""
+    size, crc = spill_file_meta(path)
+    with open(path, "rb") as f:
+        f.seek(SPILL_HEADER_BYTES)
+        payload = f.read(size)
+    if len(payload) != size:
+        raise SpillCorruptionError(f"spill file short read: {path}")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise SpillCorruptionError(f"spill file checksum mismatch: {path}")
+    return payload
 
 
 def segment_name(object_id: ObjectID) -> str:
@@ -83,11 +207,69 @@ class ObjectStore:
         size = serialization.serialized_size(payload, buffers)
         return self.put_serialized(object_id, payload, buffers, size), size
 
+    def _pool_create_backpressured(self, key: bytes, size: int):
+        """pool.create with the degradation ladder: a full pool blocks
+        the put (bounded by ``put_backpressure_timeout_s``) so the spill
+        rung can free space, instead of falling straight off to an
+        unbounded per-object segment (reference: plasma creates queue
+        under pressure rather than failing immediately). Returns the
+        writable view, or None when the object already exists, can never
+        fit, or the deadline passed (callers then take the segment
+        fallback — and only ITS failure surfaces OutOfMemoryError)."""
+        from . import chaos as _chaos
+        from .config import RayConfig
+
+        view = self._pool.create(key, max(size, 1))
+        if view is not None or self._pool.contains(key):
+            return view
+        try:
+            st = self._pool.stats()
+            cap = st.get("pool_size") or st.get("arena_size") or 0
+        except Exception:  # noqa: BLE001 - store mid-close
+            return None
+        if not cap or size >= cap:
+            return None  # can never fit: segment fallback immediately
+        deadline = time.monotonic() + float(
+            RayConfig.put_backpressure_timeout_s
+        )
+        backoff = _chaos.Backoff(base_s=0.01, cap_s=0.25)
+        waited = False
+        t0 = time.monotonic()
+        last_in_use = st.get("bytes_in_use", 0)
+        stalls = 0
+        while time.monotonic() < deadline:
+            time.sleep(min(backoff.next_delay(),
+                           max(0.0, deadline - time.monotonic())))
+            waited = True
+            view = self._pool.create(key, max(size, 1))
+            if view is not None or self._pool.contains(key):
+                break
+            # Blocking only helps if someone is actually freeing pool
+            # space (the head's spill rung; a releasing reader). Daemon
+            # nodes run no spiller, and a pool full of live objects
+            # never drains — detect the stall (in-use bytes not
+            # falling) and take the segment fallback early instead of
+            # sleeping out the whole deadline.
+            try:
+                in_use = self._pool.stats().get("bytes_in_use", 0)
+            except Exception:  # noqa: BLE001 - store mid-close
+                break
+            stalls = stalls + 1 if in_use >= last_in_use else 0
+            last_in_use = min(last_in_use, in_use)
+            if stalls >= 4 and time.monotonic() - t0 > 0.6:
+                break
+        if waited and _events.enabled():
+            _events.record(
+                _events.OBJECT, ObjectID(key).hex()[:12], "PUT_BACKPRESSURE",
+                {"bytes": size, "admitted": view is not None},
+            )
+        return view
+
     def put_serialized(self, object_id: ObjectID, payload, buffers, size) -> str:
         """Write an already-serialized value; returns its location name."""
         _rec = _events.get_recorder()
         if self._pool is not None:
-            view = self._pool.create(object_id.binary(), max(size, 1))
+            view = self._pool_create_backpressured(object_id.binary(), size)
             if view is not None:
                 serialization.write_to(view, payload, buffers)
                 del view
@@ -99,8 +281,7 @@ class ObjectStore:
                     )
                 return "pool"
         name = segment_name(object_id)
-        shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
-        _untrack(shm)
+        shm = self._create_segment(name, size)
         serialization.write_to(shm.buf, payload, buffers)
         with self._lock:
             self._segments[name] = shm
@@ -117,19 +298,40 @@ class ObjectStore:
         remote driver's value without deserializing it."""
         size = max(len(blob), 1)
         if self._pool is not None:
-            view = self._pool.create(object_id.binary(), size)
+            view = self._pool_create_backpressured(object_id.binary(), size)
             if view is not None:
                 view[: len(blob)] = blob
                 del view
                 self._pool.seal(object_id.binary())
                 return "pool"
         name = segment_name(object_id)
-        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
-        _untrack(shm)
+        shm = self._create_segment(name, size)
         shm.buf[: len(blob)] = blob
         with self._lock:
             self._segments[name] = shm
         return name
+
+    def _create_segment(self, name: str, size: int) -> shared_memory.SharedMemory:
+        """Segment-fallback create. This is the LAST rung of the put
+        ladder (pool admission + backpressure already had their turn):
+        an ENOSPC here means the node genuinely cannot hold the object,
+        which surfaces as OutOfMemoryError — never a raw OSError killing
+        the caller's control loop."""
+        try:
+            shm = shared_memory.SharedMemory(
+                name=name, create=True, size=max(size, 1)
+            )
+        except OSError as e:
+            if e.errno in (errno.ENOSPC, errno.ENOMEM):
+                from ..exceptions import OutOfMemoryError
+
+                raise OutOfMemoryError(
+                    f"object store full: cannot allocate {size} bytes "
+                    "(pool backpressured and /dev/shm exhausted)"
+                ) from e
+            raise
+        _untrack(shm)
+        return shm
 
     def get(self, object_id: ObjectID) -> Any:
         """Map and deserialize a sealed object (zero-copy buffers)."""

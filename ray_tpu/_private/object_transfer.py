@@ -46,6 +46,12 @@ class ObjectTransferServer:
         self.address = transport.listener_address(self._listener)
         self._peers = []
         self._shutdown = False
+        # Spill files already checksum-verified by this server, keyed
+        # (path, size, mtime_ns): spill files are immutable once
+        # renamed into place, so one streaming CRC pass covers every
+        # subsequent puller/retry instead of re-reading the whole file
+        # per offset-0 request. Bounded FIFO.
+        self._verified_spills: "Dict[Tuple[str, int, int], bool]" = {}
         self._thread = threading.Thread(
             target=self._accept_loop, name="obj-transfer-accept", daemon=True
         )
@@ -88,19 +94,45 @@ class ObjectTransferServer:
             # Restore rung: the object may have been spilled to disk on
             # this node; serve the file so cross-node pulls of spilled
             # objects still work (reference: spilled-object restore,
-            # local_object_manager.h:100-110).
+            # local_object_manager.h:100-110). The header is validated
+            # before a single byte leaves — a truncated or corrupt spill
+            # file answers "not found" (the consumer's get resolves
+            # through lineage reconstruction), never garbage.
             import os
 
-            from .object_store import spill_path
+            from .object_store import (
+                SPILL_HEADER_BYTES, SpillCorruptionError, spill_file_meta,
+                spill_path, verify_spill_file,
+            )
 
             spill_dir = os.environ.get("RAY_TPU_SPILL_DIR", "")
             path = spill_path(spill_dir, oid) if spill_dir else ""
             try:
+                if offset == 0:
+                    # Full streaming checksum once per FILE (no
+                    # payload-sized allocation): immutable spill files
+                    # verify on their first offset-0 request and later
+                    # pulls/retries hit the verified cache; non-zero
+                    # offsets re-check only the cheap size header.
+                    st = os.stat(path)
+                    ck = (path, st.st_size, st.st_mtime_ns)
+                    if ck in self._verified_spills:
+                        size, _crc = spill_file_meta(path)
+                    else:
+                        size = verify_spill_file(path)
+                        if len(self._verified_spills) >= 1024:
+                            self._verified_spills.pop(
+                                next(iter(self._verified_spills))
+                            )
+                        self._verified_spills[ck] = True
+                else:
+                    size, _crc = spill_file_meta(path)
                 with open(path, "rb") as f:
-                    f.seek(offset)
+                    f.seek(SPILL_HEADER_BYTES + offset)
                     data = f.read(CHUNK_BYTES)
-                    size = os.path.getsize(path)
                 peer.reply(msg, ok=True, data=data, size=size)
+            except SpillCorruptionError as e:
+                peer.reply(msg, ok=False, error=f"spill corrupt: {e}")
             except OSError:
                 peer.reply(msg, ok=False, error="object not found")
             return

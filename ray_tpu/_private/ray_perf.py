@@ -443,6 +443,37 @@ CHAOS_SMOKE = {
     "spec": CHAOS_SPEC,
 }
 
+# Memory-pressure soak: a bulk broadcast chunk-train + thousands of
+# small gets against a deliberately small pool, then storage-plane
+# chaos (spill IO errors, disk-full, truncated spill files). Asserts
+# the admission-control invariants (gets never starved, in-flight pull
+# bytes <= budget — straight from PULL_* flight-recorder events) and
+# that every injected storage fault degrades (backpressure /
+# OutOfMemoryError / lineage reconstruction), never crashes a daemon,
+# wedges a get, or returns silently wrong bytes.
+PRESSURE_SPEC = (
+    "io_error:spill_write=p:0.25,"
+    "disk_full:spill=p:0.15,"
+    "truncate:spill_file=p:0.3"
+)
+PRESSURE_FULL = {
+    "nodes": 8, "chunk_bytes": 128 << 20, "n_chunks": 8,  # 1 GiB train
+    "small_bytes": 220 << 10, "gets_per_node": 250,       # 2000 small gets
+    "pool_bytes": 256 << 20, "pull_budget": 160 << 20,
+    "pressure_objects": 48, "pressure_bytes": 4 << 20,
+    "seed": 0x93E55, "spec": PRESSURE_SPEC, "get_timeout_s": 300.0,
+    "p99_bound_s": 60.0,
+}
+PRESSURE_SMOKE = {
+    "nodes": 8, "chunk_bytes": 8 << 20, "n_chunks": 4,    # 32 MiB train
+    "small_bytes": 200 << 10, "gets_per_node": 40,        # 320 small gets
+    "pool_bytes": 48 << 20, "pull_budget": 12 << 20,
+    "pressure_objects": 24, "pressure_bytes": 2 << 20,
+    "seed": 0x93E55, "spec": PRESSURE_SPEC, "get_timeout_s": 180.0,
+    "p99_bound_s": 30.0,
+}
+
+
 # Head-failover soak: the head itself is the kill target. Message
 # chaos stays on the at-least-once paths (dup/delay on done batches
 # and ref flushes exercises the per-conn sequencers across the
@@ -1337,6 +1368,384 @@ def bench_head_failover(cfg: Dict[str, float]):
         shutil.rmtree(session_dir, ignore_errors=True)
 
 
+@ray_tpu.remote(num_cpus=1, max_retries=2)
+def _pressure_fetch(chunk_refs, small_refs, get_timeout):
+    """Pressure-soak consumer: one thread pulls the broadcast chunk
+    train at task-args priority while the main thread times small gets
+    — both through this worker's admission-controlled pull manager, so
+    the small gets must jump the queued chunks (get > task-args)."""
+    import threading as _th
+
+    import numpy as _np
+
+    from ray_tpu._private.object_plane import pull_manager as _pm
+
+    train = {"bytes": 0, "bad": 0, "error": ""}
+
+    def pull_train():
+        try:
+            with _pm.pull_class(_pm.PULL_TASK_ARGS):
+                for i, r in enumerate(chunk_refs):
+                    a = ray_tpu.get(r, timeout=get_timeout)
+                    a = _np.asarray(a)
+                    train["bytes"] += a.nbytes
+                    if int(a[0]) != i % 251 or int(a[-1]) != i % 251:
+                        train["bad"] += 1
+        except Exception as e:  # noqa: BLE001 - tallied, not silent
+            train["error"] = f"{type(e).__name__}: {e}"
+
+    th = _th.Thread(target=pull_train, daemon=True)
+    th.start()
+    lat: List[float] = []
+    deadline = time.monotonic() + get_timeout
+    for r in small_refs:
+        t0 = time.perf_counter()
+        v = ray_tpu.get(r, timeout=get_timeout)
+        lat.append(time.perf_counter() - t0)
+        assert _np.asarray(v)[0] >= 0
+        if time.monotonic() > deadline:
+            break
+    th.join(get_timeout)
+    return {
+        "bytes": train["bytes"], "bad": train["bad"],
+        "error": train["error"], "train_done": not th.is_alive(),
+        "lat": lat,
+    }
+
+
+@ray_tpu.remote(num_cpus=1, max_retries=5)
+def _pressure_make(i, n):
+    """Lineage-backed pressure object: if its spilled copy is lost or
+    truncated by chaos, the owner's get MUST reconstruct it by re-running
+    this task — correct bytes, never garbage, never a wedge."""
+    import numpy as _np
+
+    return _np.full(n, i % 251, dtype=_np.uint8)
+
+
+def bench_pressure_soak(cfg: Dict[str, float]):
+    """Admission-controlled object plane under memory pressure
+    (acceptance: ISSUE 10): a broadcast chunk train to ``nodes`` real
+    daemon nodes concurrent with thousands of small gets under a
+    deliberately small pool and in-flight pull budget, then storage
+    chaos (spill IO error / disk full / truncated spill file). Asserts
+    (a) small gets are never starved (bounded p99), (b) admitted
+    in-flight pull bytes never exceed the budget — verified from
+    PULL_ACTIVATE flight-recorder events, (c) zero wedged gets, (d) the
+    broadcast lands bit-exact on every node, (e) injected storage
+    faults end in backpressure / OutOfMemoryError / lineage
+    reconstruction — never a crashed daemon or silently wrong bytes,
+    (f) no leaked pool bytes once refs drop. Deterministic per seed."""
+    import gc
+    import os
+    import tempfile
+
+    from ray_tpu.cluster_utils import DaemonCluster
+    from ray_tpu._private import chaos as _chaos
+    from ray_tpu._private import events as _events
+    from ray_tpu._private.config import RayConfig
+    from ray_tpu._private.state import list_cluster_events
+    from ray_tpu._private.worker import _global, global_client
+    from ray_tpu.exceptions import (
+        GetTimeoutError, ObjectLostError, OutOfMemoryError,
+    )
+
+    seed = int(cfg["seed"])
+    spec = str(cfg["spec"])
+    nodes = int(cfg["nodes"])
+    chunk_bytes = int(cfg["chunk_bytes"])
+    n_chunks = int(cfg["n_chunks"])
+    get_timeout = float(cfg["get_timeout_s"])
+    print(f"pressure_soak: seed={seed} (reproduce with --chaos-seed {seed})")
+    print(f"pressure_soak: spec={spec}")
+
+    # The soak needs its own session: a deliberately small pool + pull
+    # budget, carried through the ENVIRONMENT so every daemon and
+    # worker spawned below inherits the same constraints.
+    ray_tpu.shutdown()
+    spill_dir = tempfile.mkdtemp(prefix="rtpu_pressure_spill_")
+    soak_env = {
+        "RAY_TPU_object_store_memory_bytes": str(int(cfg["pool_bytes"])),
+        "RAY_TPU_pull_in_flight_bytes": str(int(cfg["pull_budget"])),
+        "RAY_TPU_put_backpressure_timeout_s": "3.0",
+        "RAY_TPU_object_spilling_threshold": "0.6",
+    }
+    os.environ.update(soak_env)
+    problems: List[str] = []
+    wedged: List[str] = []
+    try:
+        ray_tpu.init(
+            num_cpus=2, tcp_port=0,
+            _system_config={"object_spilling_directory": spill_dir},
+        )
+        gcs = _global.node.gcs
+        client = global_client()
+        pool = getattr(gcs._store, "_pool", None)
+        try:
+            cluster = DaemonCluster.attach()
+        except RuntimeError:
+            RESULTS["pressure_soak_skipped"] = 1.0
+            print("pressure_soak: SKIPPED — head has no TCP control plane")
+            return
+        before = len(ray_tpu.nodes())
+        t0 = time.perf_counter()
+        for i in range(nodes):
+            cluster.add_node(
+                num_cpus=2, resources={f"pn{i}": 2.0}, label=f"press{i}",
+                wait=False,
+            )
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if len(ray_tpu.nodes()) >= before + nodes:
+                break
+            time.sleep(0.2)
+        alive = len(ray_tpu.nodes()) - before
+        if alive < nodes:
+            RESULTS["pressure_soak_skipped"] = 1.0
+            print(
+                f"pressure_soak: SKIPPED — only {alive}/{nodes} daemon "
+                "nodes registered within 300s"
+            )
+            return
+        print(
+            f"pressure_soak: {nodes} daemon nodes up in "
+            f"{time.perf_counter() - t0:.1f}s "
+            f"(pool={int(cfg['pool_bytes']) >> 20} MiB, "
+            f"budget={int(cfg['pull_budget']) >> 20} MiB)"
+        )
+        # Warm one worker per node (the soak measures the object plane,
+        # not interpreter boots).
+        ray_tpu.get(
+            [
+                _pressure_fetch.options(resources={f"pn{i}": 1.0}).remote(
+                    [], [], 60.0
+                )
+                for i in range(nodes)
+            ],
+            timeout=300,
+        )
+        gc.collect()
+        client._tracker.flush(client)
+        time.sleep(0.5)
+        baseline_bytes = (
+            pool.stats().get("bytes_in_use", 0) if pool is not None else 0
+        )
+
+        # ---------------- phase A: broadcast train + small gets --------
+        chunks = [
+            ray_tpu.put(np.full(chunk_bytes, i % 251, dtype=np.uint8))
+            for i in range(n_chunks)
+        ]
+        per_node = int(cfg["gets_per_node"])
+        small_n = max(1, int(cfg["small_bytes"]) // 8)
+        smalls = [
+            ray_tpu.put(np.full(small_n, float(i)))
+            for i in range(nodes * per_node)
+        ]
+        t = time.perf_counter()
+        fetches = [
+            _pressure_fetch.options(resources={f"pn{i}": 1.0}).remote(
+                chunks, smalls[i * per_node:(i + 1) * per_node], get_timeout
+            )
+            for i in range(nodes)
+        ]
+        try:
+            reports = ray_tpu.get(fetches, timeout=get_timeout + 120)
+        except GetTimeoutError as e:
+            wedged.append(f"broadcast fetch: {e}")
+            reports = []
+        bcast_s = time.perf_counter() - t
+        lats = [s for r in reports for s in r["lat"]]
+        total = n_chunks * chunk_bytes
+        for i, r in enumerate(reports):
+            if r["error"] or not r["train_done"]:
+                problems.append(
+                    f"node {i} chunk train incomplete: "
+                    f"{r['error'] or 'timed out'}"
+                )
+            elif r["bytes"] != total or r["bad"]:
+                problems.append(
+                    f"node {i} broadcast corrupt: {r['bytes']}/{total} "
+                    f"bytes, {r['bad']} bad chunks"
+                )
+        lats.sort()
+        p50 = lats[len(lats) // 2] if lats else float("nan")
+        p99 = lats[int(0.99 * (len(lats) - 1))] if lats else float("nan")
+        RESULTS["pressure_broadcast_s"] = round(bcast_s, 3)
+        RESULTS["pressure_small_gets"] = len(lats)
+        RESULTS["pressure_small_get_p50_s"] = round(p50, 4)
+        RESULTS["pressure_small_get_p99_s"] = round(p99, 4)
+        print(
+            f"pressure_soak: broadcast {nodes}x{total >> 20} MiB in "
+            f"{bcast_s:.1f}s; {len(lats)} small gets p50={p50 * 1e3:.1f}ms "
+            f"p99={p99 * 1e3:.1f}ms"
+        )
+        if not lats:
+            problems.append("no small gets completed")
+        elif p99 > float(cfg["p99_bound_s"]):
+            problems.append(
+                f"small gets starved: p99 {p99:.1f}s > "
+                f"{cfg['p99_bound_s']}s bound"
+            )
+        ray_tpu.free(chunks + smalls)
+        del chunks, smalls
+
+        # Admission invariant, straight from the flight recorder: no
+        # activation may put in-flight bytes over its budget reading
+        # (solo = the oversize-liveness exception, absent by
+        # construction here: every object fits the budget).
+        activates = list_cluster_events(
+            category="refs", event="PULL_ACTIVATE", limit=100_000
+        )
+        queued = list_cluster_events(
+            category="refs", event="PULL_QUEUED", limit=100_000
+        )
+        over = [
+            e for e in activates
+            if not (e.get("attrs") or {}).get("solo")
+            and (e.get("attrs") or {}).get("in_flight", 0)
+            > (e.get("attrs") or {}).get("budget", 0)
+        ]
+        solo = [e for e in activates if (e.get("attrs") or {}).get("solo")]
+        RESULTS["pressure_pull_activations"] = len(activates)
+        RESULTS["pressure_pull_queued"] = len(queued)
+        print(
+            f"pressure_soak: {len(activates)} activations "
+            f"({len(queued)} queued, {len(solo)} solo) — "
+            f"budget overruns: {len(over)}"
+        )
+        if not activates:
+            problems.append("no PULL_ACTIVATE events — manager inactive?")
+        if over:
+            problems.append(
+                f"{len(over)} activations exceeded the in-flight budget"
+            )
+        # (solo admissions are the documented oversize/demotion liveness
+        # exception — reported above, not a failure.)
+
+        # ---------------- phase B: storage chaos -----------------------
+        os.environ["RAY_TPU_chaos_spec"] = spec
+        os.environ["RAY_TPU_chaos_seed"] = str(seed)
+        RayConfig._values["chaos_spec"] = spec
+        RayConfig._values["chaos_seed"] = seed
+        _chaos.install(spec, seed, RayConfig.testing_rpc_delay_us)
+        n_press = int(cfg["pressure_objects"])
+        press_n = int(cfg["pressure_bytes"])
+        made = [
+            _pressure_make.remote(i, press_n) for i in range(n_press // 2)
+        ]
+        puts = [
+            ray_tpu.put(np.full(press_n, (100 + i) % 251, dtype=np.uint8))
+            for i in range(n_press // 2)
+        ]
+        outcomes = {"ok": 0, "lost": 0, "oom": 0}
+        try:
+            ray_tpu.get(made, timeout=get_timeout)
+        except GetTimeoutError as e:
+            wedged.append(f"pressure make: {e}")
+        except Exception:  # noqa: BLE001 - per-object loop re-judges below
+            pass
+        for _ in range(6):
+            client.request({"type": "spill_tick"})
+            time.sleep(0.1)
+        for i, r in enumerate(made):
+            try:
+                v = ray_tpu.get(r, timeout=get_timeout)
+                if int(v[0]) != i % 251 or int(v[-1]) != i % 251:
+                    problems.append(f"lineage object {i}: WRONG BYTES")
+                else:
+                    outcomes["ok"] += 1
+            except GetTimeoutError as e:
+                wedged.append(f"lineage get {i}: {e}")
+            except ObjectLostError:
+                # Lineage-backed objects must reconstruct, not fail.
+                problems.append(f"lineage object {i} lost (no reconstruct)")
+        for i, r in enumerate(puts):
+            try:
+                v = ray_tpu.get(r, timeout=get_timeout)
+                if int(v[0]) != (100 + i) % 251:
+                    problems.append(f"put object {i}: WRONG BYTES")
+                else:
+                    outcomes["ok"] += 1
+            except GetTimeoutError as e:
+                wedged.append(f"put get {i}: {e}")
+            except ObjectLostError:
+                outcomes["lost"] += 1  # no lineage: LOST is the ladder
+            except OutOfMemoryError:
+                outcomes["oom"] += 1
+        faults = [
+            e for e in list_cluster_events(category="chaos", limit=100_000)
+            if e["event"] == "FAULT"
+        ]
+        RESULTS["pressure_storage_faults"] = len(faults)
+        RESULTS["pressure_outcomes_ok"] = outcomes["ok"]
+        RESULTS["pressure_outcomes_lost"] = outcomes["lost"]
+        print(
+            f"pressure_soak: storage chaos — {len(faults)} faults "
+            f"injected, outcomes={outcomes}"
+        )
+        if not faults:
+            problems.append("no storage faults injected — engine inactive?")
+        v = None  # drop the last outcome loop's zero-copy view
+        read_ids = [r.id() for r in made + puts]
+        ray_tpu.free(made + puts)
+        del made, puts
+
+        # ---------------- leak + liveness ------------------------------
+        if not client.request({"type": "msg_counts"}).get("ok"):
+            problems.append("head unresponsive after storage chaos")
+        gc.collect()
+        # The gets above pinned pool refcounts for their zero-copy views
+        # (freed entries defer the actual free to the last release);
+        # the views are dead now, so drop the pins before accounting.
+        for oid in read_ids:
+            try:
+                client.store.release(oid)
+            except Exception:  # noqa: BLE001
+                pass
+        client._tracker.flush(client)
+        leaked_bytes = 0
+        if pool is not None:
+            leak_deadline = time.monotonic() + 60
+            while time.monotonic() < leak_deadline:
+                gc.collect()
+                client._tracker.flush(client)
+                gcs.objects.flush(timeout=5)
+                leaked_bytes = max(
+                    0,
+                    pool.stats().get("bytes_in_use", 0) - baseline_bytes,
+                )
+                if leaked_bytes <= 4 << 20:
+                    break
+                time.sleep(1.0)
+        RESULTS["pressure_leaked_bytes"] = leaked_bytes
+        if leaked_bytes > 4 << 20:
+            problems.append(f"{leaked_bytes} pool bytes leaked")
+        if wedged:
+            problems.append(f"wedged gets: {wedged}")
+        if problems:
+            RESULTS["pressure_soak_ok"] = 0.0
+            raise RuntimeError(
+                f"pressure_soak FAILED (seed={seed}; reproduce with "
+                f"--only pressure_soak --chaos-seed {seed}): "
+                + "; ".join(problems)
+            )
+        RESULTS["pressure_soak_ok"] = 1.0
+    finally:
+        for key in (*soak_env, "RAY_TPU_chaos_spec", "RAY_TPU_chaos_seed"):
+            os.environ.pop(key, None)
+        RayConfig._values["chaos_spec"] = ""
+        RayConfig._values["chaos_seed"] = 0
+        _chaos.install("", 0, RayConfig.testing_rpc_delay_us)
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        import shutil as _shutil
+
+        _shutil.rmtree(spill_dir, ignore_errors=True)
+
+
 def bench_placement_groups():
     from ray_tpu.util.placement_group import (
         placement_group,
@@ -1358,7 +1767,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--only", default=None,
         help="comma-separated subset: tasks,actors,objects,pgs,scale,"
-        "object_envelope,chaos_soak,head_failover",
+        "object_envelope,chaos_soak,head_failover,pressure_soak",
     )
     parser.add_argument(
         "--envelope-smoke", action="store_true",
@@ -1377,6 +1786,11 @@ def main(argv=None) -> int:
         "--failover-smoke", action="store_true",
         help="short head_failover config: 1 head kill, small cluster, "
         "bounded wall time (make failover-smoke)",
+    )
+    parser.add_argument(
+        "--pressure-smoke", action="store_true",
+        help="scaled-down pressure_soak config: 32 MiB chunk train to "
+        "8 nodes, small pool/budget (make pressure-smoke)",
     )
     parser.add_argument(
         "--chaos-seed", type=int, default=None,
@@ -1420,6 +1834,11 @@ def main(argv=None) -> int:
         failover_cfg["seed"] = args.chaos_seed
     if args.chaos_seconds is not None:
         failover_cfg["seconds"] = args.chaos_seconds
+    pressure_cfg = dict(
+        PRESSURE_SMOKE if args.pressure_smoke else PRESSURE_FULL
+    )
+    if args.chaos_seed is not None:
+        pressure_cfg["seed"] = args.chaos_seed
     groups = {
         "tasks": bench_tasks,
         "actors": bench_actor_calls,
@@ -1429,8 +1848,11 @@ def main(argv=None) -> int:
         "object_envelope": lambda: bench_object_envelope(env_cfg),
         "chaos_soak": lambda: bench_chaos_soak(chaos_cfg),
         "head_failover": lambda: bench_head_failover(failover_cfg),
+        "pressure_soak": lambda: bench_pressure_soak(pressure_cfg),
     }
-    _opt_in = ("object_envelope", "chaos_soak", "head_failover")
+    _opt_in = (
+        "object_envelope", "chaos_soak", "head_failover", "pressure_soak"
+    )
     selected = (
         [s.strip() for s in args.only.split(",")]
         if args.only

@@ -86,7 +86,14 @@ class NodeDaemon:
             from .native_store import PoolStore, native_available
 
             if native_available():
-                self._pool = PoolStore(pool_name, create=True)
+                # Honor the session's configured store size (env-carried
+                # RAY_TPU_object_store_memory_bytes): a deliberately
+                # constrained pool must constrain every node, not just
+                # the head — the memory-pressure soaks depend on it.
+                self._pool = PoolStore(
+                    pool_name, create=True,
+                    pool_bytes=RayConfig.object_store_memory_bytes or None,
+                )
                 os.environ["RAY_TPU_POOL_NAME"] = pool_name
             else:
                 os.environ.pop("RAY_TPU_POOL_NAME", None)

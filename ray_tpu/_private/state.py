@@ -92,10 +92,13 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
     except Exception:  # noqa: BLE001 - recorder disabled or old head
         pass
     try:
-        # Object-plane rows (pid "object_plane"): shard applies render
-        # as duration slices, flush/enqueue/promotion as instants — an
-        # object-plane stall shows up NEXT TO the task phase it delays
-        # (e.g. a long SHARD_APPLY beside widened seal phases).
+        # Object-plane rows (pid "object_plane"): shard applies and
+        # admitted pulls (PULL_DONE carries the activate→done window)
+        # render as duration slices, flush/enqueue/promotion/queueing/
+        # cancellation/spill failures as instants — an object-plane
+        # stall shows up NEXT TO the task phase it delays (e.g. a long
+        # SHARD_APPLY beside widened seal phases, a starved PULL_QUEUED
+        # train beside a broadcast).
         refs_events = list_cluster_events(category="refs", limit=100_000)
         for ev in refs_events:
             attrs = ev.get("attrs") or {}
@@ -107,7 +110,8 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
                 "tid": ev["entity"],
                 "args": {**attrs, "entity": ev["entity"]},
             }
-            if name == "SHARD_APPLY" and attrs.get("seconds") is not None:
+            if name in ("SHARD_APPLY", "PULL_DONE") and \
+                    attrs.get("seconds") is not None:
                 dur = float(attrs["seconds"]) * 1e6
                 trace.append(
                     {

@@ -577,20 +577,26 @@ class WorkerRuntime:
         return fn
 
     def _resolve_args(self, spec: TaskSpec):
+        from .object_plane import pull_manager as _pullm
         from .submit import _EMPTY_ARGS_BLOB
 
         if spec.args_blob == _EMPTY_ARGS_BLOB:
             return [], {}
         args, kwargs = serialization.unpack(spec.args_blob)
         # Top-level ObjectRefs are resolved to values; nested refs pass
-        # through as refs (the reference's borrowing semantics).
-        args = [
-            self.client.get([a])[0] if isinstance(a, ObjectRef) else a for a in args
-        ]
-        kwargs = {
-            k: self.client.get([v])[0] if isinstance(v, ObjectRef) else v
-            for k, v in kwargs.items()
-        }
+        # through as refs (the reference's borrowing semantics). Pulls
+        # these gets trigger ride the task-args admission class —
+        # user-facing ray.get pulls activate ahead of them
+        # (pull_manager.h priority order).
+        with _pullm.pull_class(_pullm.PULL_TASK_ARGS):
+            args = [
+                self.client.get([a])[0] if isinstance(a, ObjectRef) else a
+                for a in args
+            ]
+            kwargs = {
+                k: self.client.get([v])[0] if isinstance(v, ObjectRef) else v
+                for k, v in kwargs.items()
+            }
         return args, kwargs
 
     # -------------------------------------------------------------- execute

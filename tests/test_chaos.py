@@ -488,3 +488,82 @@ def test_oom_victim_ordering_groups_by_owner():
         [w(job_a, 1.0, retries=0), leased]
     )
     assert order3[0].state == W_LEASED
+
+
+# ------------------------------------------------- storage fault points
+
+
+def test_fault_point_rules_parse_and_fire_deterministically():
+    """Storage-plane fault rules (io_error:/disk_full:/truncate:) share
+    the kill-rule grammar — nth-hit and probabilistic — and the same
+    seeded determinism."""
+    s = FaultSchedule("io_error:spill_write=2", seed=5)
+    assert [s.maybe_fault("io_error:spill_write") for _ in range(4)] == [
+        False, True, False, False,
+    ]
+    assert not s.maybe_fault("disk_full:spill")  # no rule installed
+    p1 = FaultSchedule("truncate:spill_file=p:0.4", seed=11)
+    p2 = FaultSchedule("truncate:spill_file=p:0.4", seed=11)
+    t1 = [p1.maybe_fault("truncate:spill_file") for _ in range(100)]
+    t2 = [p2.maybe_fault("truncate:spill_file") for _ in range(100)]
+    assert t1 == t2 and any(t1) and not all(t1)
+    p3 = FaultSchedule("truncate:spill_file=p:0.4", seed=12)
+    assert t1 != [p3.maybe_fault("truncate:spill_file") for _ in range(100)]
+
+
+def test_fault_point_module_hook_and_chaos_event():
+    """chaos.fault_point consults the installed schedule and records a
+    CHAOS FAULT flight-recorder event per injection."""
+    from ray_tpu._private import events as _events
+
+    chaos.install("disk_full:spill=1", seed=3)
+    try:
+        rec = _events.get_recorder()
+        rec.drain()
+        assert chaos.fault_point("disk_full:spill") is True
+        assert chaos.fault_point("disk_full:spill") is False  # nth=1 only
+        assert chaos.fault_point("io_error:spill_write") is False
+        items, _ = rec.drain()
+        faults = [i for i in items if i[2] == _events.CHAOS
+                  and i[4] == "FAULT"]
+        assert len(faults) == 1 and faults[0][3] == "disk_full:spill"
+    finally:
+        chaos.install("", 0)
+    # chaos off: one global read, never fires.
+    assert chaos.fault_point("disk_full:spill") is False
+
+
+def test_spill_write_fault_points_injected(tmp_path, monkeypatch):
+    """write_spill_file honors all three storage fault points: EIO,
+    ENOSPC, and a post-rename truncation that read_spill_file detects
+    — garbage can never restore."""
+    import errno
+
+    import numpy as np
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import (
+        SpillCorruptionError, read_spill_file, write_spill_file,
+    )
+
+    oid = ObjectID(b"s" * 16)
+    payload = np.arange(1024, dtype=np.int64).tobytes()
+
+    chaos.install("io_error:spill_write=1", seed=1)
+    with pytest.raises(OSError) as ei:
+        write_spill_file(str(tmp_path), oid, payload)
+    assert ei.value.errno == errno.EIO
+
+    chaos.install("disk_full:spill=1", seed=1)
+    with pytest.raises(OSError) as ei:
+        write_spill_file(str(tmp_path), oid, payload)
+    assert ei.value.errno == errno.ENOSPC
+
+    chaos.install("truncate:spill_file=1", seed=1)
+    path = write_spill_file(str(tmp_path), oid, payload)
+    with pytest.raises(SpillCorruptionError):
+        read_spill_file(path)
+
+    chaos.install("", 0)
+    path = write_spill_file(str(tmp_path), oid, payload)
+    assert read_spill_file(path) == payload

@@ -40,19 +40,21 @@ def test_spilling_keeps_live_objects_readable(tmp_path):
         },
     )
     try:
+        from ray_tpu._private.worker import global_client
+
+        client = global_client()
         each = 2 << 20  # 2 MiB per object
         n = (2 * pool_bytes) // each  # 2x pool size, all live refs
         refs = []
         for i in range(n):
             refs.append(ray_tpu.put(np.full(each // 4, i, dtype=np.int32)))
-            time.sleep(0.02)  # give the spill monitor ticks to run
-        deadline = time.monotonic() + 20
-        spilled = []
-        while time.monotonic() < deadline:
-            spilled = os.listdir(spill_dir) if os.path.isdir(spill_dir) else []
-            if spilled:
-                break
-            time.sleep(0.2)
+            # Deterministic: drive the spill rung directly instead of
+            # sleep-polling the 0.2s monitor cadence (the old
+            # time.sleep(0.02) waits made this test a flake magnet).
+            if i % 4 == 3:
+                client.request({"type": "spill_tick"})
+        client.request({"type": "spill_tick"})
+        spilled = os.listdir(spill_dir) if os.path.isdir(spill_dir) else []
         assert spilled, "no objects were spilled at 2x pool occupancy"
         # Every object — spilled or resident — still reads correctly.
         for i, ref in enumerate(refs):
@@ -209,5 +211,158 @@ def test_oom_prefers_retriable_and_resubmits(tmp_path):
         time.sleep(0.5)
         usage_file.write_text("0.10")  # recover so the retry survives
         assert ray_tpu.get(ref, timeout=30) == "second attempt"
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.skipif(
+    not _native_pool_available(),
+    reason="spilling manages the native pool arena; no native store here",
+)
+def test_truncated_spill_file_never_returns_garbage(tmp_path):
+    """Regression (ISSUE 10): a hand-truncated spill file must NEVER
+    restore as silently wrong bytes. A put object (no lineage) resolves
+    ObjectLostError — the directory drops the bad file and answers LOST
+    — while a task-produced object reconstructs through lineage on the
+    next get (correct bytes, not an error)."""
+    from ray_tpu._private.object_store import spill_path
+    from ray_tpu._private.worker import _global, global_client
+    from ray_tpu.exceptions import ObjectLostError
+
+    pool_bytes = 8 << 20
+    spill_dir = str(tmp_path / "spill")
+    ray_tpu.init(
+        num_cpus=2,
+        ignore_reinit_error=True,
+        _system_config={
+            "object_store_memory_bytes": pool_bytes,
+            "object_spilling_directory": spill_dir,
+            "object_spilling_threshold": 0.3,
+        },
+    )
+    try:
+        client = global_client()
+        gcs = _global.node.gcs
+
+        def truncate(ref):
+            path = spill_path(spill_dir, ref.id())
+            with open(path, "r+b") as f:
+                f.truncate(os.path.getsize(path) // 2)
+            return path
+
+        def spilled_of(refs):
+            return [
+                r for r in refs
+                if (e := gcs.objects.get(r.id().binary())) is not None
+                and e.spilled_path is not None
+            ]
+
+        # -- put object (no lineage): corrupt spill resolves LOST.
+        refs = [
+            ray_tpu.put(np.full(256 * 1024, i, dtype=np.int32))
+            for i in range(8)
+        ]
+        client.request({"type": "spill_tick"})
+        spilled = spilled_of(refs)
+        assert spilled, "nothing spilled at 4x the threshold"
+        victim = spilled[0]
+        path = truncate(victim)
+        # The driver holds no local copy (puts went straight to pool and
+        # the pool copy was freed by the spill) — the get must detect
+        # the corruption and fail LOST, never return truncated bytes.
+        with pytest.raises(ObjectLostError):
+            ray_tpu.get(victim, timeout=30)
+        # The head validates the report (and unlinks the bad file) on a
+        # background thread — poll briefly for the drop to land.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and os.path.exists(path):
+            time.sleep(0.05)
+        assert not os.path.exists(path), "corrupt spill file not dropped"
+        entry = gcs.objects.get(victim.id().binary())
+        assert entry is None or entry.spilled_path is None
+        # Untouched spilled objects still restore bit-exact.
+        for r in spilled[1:]:
+            i = refs.index(r)
+            arr = ray_tpu.get(r, timeout=30)
+            assert arr[0] == i and arr[-1] == i
+        ray_tpu.free(refs)
+
+        # -- task result (lineage): corrupt spill reconstructs.
+        @ray_tpu.remote(max_retries=3)
+        def make(i):
+            return np.full(256 * 1024, i, dtype=np.int32)
+
+        made = [make.remote(i) for i in range(6)]
+        vals = ray_tpu.get(made, timeout=60)
+        assert all(int(v[0]) == i for i, v in enumerate(vals))
+        del vals
+        client.request({"type": "spill_tick"})
+        spilled = spilled_of(made)
+        if spilled:
+            victim = spilled[0]
+            i = made.index(victim)
+            truncate(victim)
+            try:
+                client.store.delete(victim.id())  # drop any local replica
+            except Exception:  # noqa: BLE001
+                pass
+            arr = ray_tpu.get(victim, timeout=60)
+            assert arr[0] == i and arr[-1] == i, \
+                "reconstruction returned junk"
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.skipif(
+    not _native_pool_available(),
+    reason="put backpressure gates on the native pool arena",
+)
+def test_put_backpressure_waits_for_spill(tmp_path):
+    """A put against a full pool blocks (bounded) while the spill rung
+    frees space, instead of immediately overflowing — and completes
+    once the ladder has run."""
+    import threading
+
+    from ray_tpu._private.worker import global_client
+
+    pool_bytes = 16 << 20
+    spill_dir = str(tmp_path / "spill")
+    ray_tpu.init(
+        num_cpus=1,
+        ignore_reinit_error=True,
+        _system_config={
+            "object_store_memory_bytes": pool_bytes,
+            "object_spilling_directory": spill_dir,
+            "object_spilling_threshold": 0.8,
+            "put_backpressure_timeout_s": 8.0,
+        },
+    )
+    try:
+        client = global_client()
+        # Fill the pool (threshold high so the monitor stays quiet).
+        refs = [
+            ray_tpu.put(np.zeros(512 * 1024, dtype=np.int32))
+            for i in range(7)
+        ]
+        # Spill ticks a moment later free space; the blocked put must
+        # complete within the backpressure window (not fall to an
+        # unbounded segment the instant the pool is full).
+        ticker_stop = threading.Event()
+
+        def tick():
+            while not ticker_stop.wait(0.3):
+                client.request({"type": "spill_tick"})
+
+        t = threading.Thread(target=tick, daemon=True)
+        t.start()
+        try:
+            late = ray_tpu.put(np.full(512 * 1024, 7, dtype=np.int32))
+            arr = ray_tpu.get(late, timeout=30)
+            assert arr[0] == 7 and arr[-1] == 7
+        finally:
+            ticker_stop.set()
+            t.join(5)
+        for r in refs:
+            assert ray_tpu.get(r, timeout=30)[0] == 0
     finally:
         ray_tpu.shutdown()

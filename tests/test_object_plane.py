@@ -696,3 +696,202 @@ def test_ref_flush_emits_flight_recorder_events(ray2):
             break
         time.sleep(0.2)
     assert want <= kinds, (kinds, _global.node.gcs.objects.stats)
+
+
+# ---------------------------------------------------- pull admission
+# Reference: pull_manager.h — get > wait > task-args priority classes
+# under a bounded in-flight byte budget; completed/failed/cancelled
+# pulls release budget and activate the next queued request.
+
+
+class _BlockingFetcher:
+    """Stands in for ObjectFetcher: pulls park on an event so tests
+    control exactly when budget releases."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.order = []
+        self.fail = set()
+
+    def pull(self, oid, address, timeout=None):
+        self.order.append(oid.binary())
+        self.release.wait(timeout)
+        return oid.binary() not in self.fail
+
+
+def _mk_oids(n):
+    from ray_tpu._private.ids import ObjectID
+
+    return [ObjectID(bytes([i + 1]) * 16) for i in range(n)]
+
+
+def _pull_in_thread(mgr, oid, size, prio, results, timeout=15):
+    from ray_tpu._private.object_plane import pull_manager as pm
+
+    def run():
+        results[oid.binary()] = mgr.pull(
+            oid, "addr", size=size, priority=prio, timeout=timeout
+        )
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def test_pull_admission_get_beats_queued_task_args():
+    """A queued get activates ahead of an earlier-queued task-arg pull
+    when budget frees (priority order, FIFO only within a class)."""
+    from ray_tpu._private.object_plane import pull_manager as pm
+
+    f = _BlockingFetcher()
+    mgr = pm.PullManager(f, budget_bytes=100)
+    a, b, c = _mk_oids(3)
+    results = {}
+    threads = [_pull_in_thread(mgr, a, 100, pm.PULL_GET, results)]
+    deadline = time.time() + 5
+    while not f.order and time.time() < deadline:
+        time.sleep(0.01)
+    assert f.order == [a.binary()]
+    # task-arg queues FIRST, then a get — each needs the whole budget.
+    threads.append(_pull_in_thread(mgr, b, 100, pm.PULL_TASK_ARGS, results))
+    time.sleep(0.05)
+    threads.append(_pull_in_thread(mgr, c, 100, pm.PULL_GET, results))
+    time.sleep(0.05)
+    s = mgr.stats()
+    assert s["queued_get"] == 1 and s["queued_task_args"] == 1
+    assert s["in_flight_bytes"] == 100
+    f.release.set()
+    for t in threads:
+        t.join(10)
+    assert f.order[1] == c.binary(), "get did not activate before task-args"
+    assert f.order[2] == b.binary()
+    assert all(results.values())
+    assert mgr.stats()["in_flight_bytes"] == 0
+
+
+def test_pull_budget_released_on_failure():
+    """A failed pull must release its budget share and activate the
+    next queued request — a lost object must not brick the plane."""
+    from ray_tpu._private.object_plane import pull_manager as pm
+
+    f = _BlockingFetcher()
+    mgr = pm.PullManager(f, budget_bytes=100)
+    a, b = _mk_oids(2)
+    f.fail.add(a.binary())
+    results = {}
+    t1 = _pull_in_thread(mgr, a, 100, pm.PULL_GET, results)
+    time.sleep(0.05)
+    t2 = _pull_in_thread(mgr, b, 100, pm.PULL_GET, results)
+    time.sleep(0.05)
+    assert len(f.order) == 1  # b is queued behind the full budget
+    f.release.set()
+    t1.join(10)
+    t2.join(10)
+    assert results[a.binary()] is False
+    assert results[b.binary()] is True
+    assert mgr.stats()["in_flight_bytes"] == 0
+
+
+def test_pull_cancel_on_ref_drop_frees_budget():
+    """Cancelling a queued pull (ref-drop) removes it from the queue
+    without it ever fetching; its budget share never activates."""
+    from ray_tpu._private.object_plane import pull_manager as pm
+
+    f = _BlockingFetcher()
+    mgr = pm.PullManager(f, budget_bytes=100)
+    a, b = _mk_oids(2)
+    results = {}
+    t1 = _pull_in_thread(mgr, a, 100, pm.PULL_GET, results)
+    time.sleep(0.05)
+    t2 = _pull_in_thread(mgr, b, 80, pm.PULL_TASK_ARGS, results, timeout=30)
+    time.sleep(0.05)
+    assert mgr.stats()["queued_task_args"] == 1
+    assert mgr.cancel(b.binary()) == 1
+    t2.join(5)
+    assert results[b.binary()] is False
+    assert mgr.stats()["queued_task_args"] == 0
+    f.release.set()
+    t1.join(10)
+    assert f.order == [a.binary()]  # b never fetched
+    assert mgr.stats()["in_flight_bytes"] == 0
+
+
+def test_pull_fifo_within_class_and_oversize_solo():
+    """FIFO within one class; an object bigger than the whole budget
+    still runs (alone) — liveness over strictness."""
+    from ray_tpu._private.object_plane import pull_manager as pm
+
+    f = _BlockingFetcher()
+    f.release.set()  # no blocking: drain in admission order
+    mgr = pm.PullManager(f, budget_bytes=100)
+    big = _mk_oids(1)[0]
+    assert mgr.pull(big, "addr", size=10_000, priority=pm.PULL_GET,
+                    timeout=5)
+    assert f.order == [big.binary()]
+    assert mgr.stats()["in_flight_bytes"] == 0
+
+    f2 = _BlockingFetcher()
+    mgr2 = pm.PullManager(f2, budget_bytes=100)
+    oids = _mk_oids(4)
+    results = {}
+    threads = [_pull_in_thread(mgr2, oids[0], 100, pm.PULL_GET, results)]
+    time.sleep(0.05)
+    for o in oids[1:]:
+        threads.append(
+            _pull_in_thread(mgr2, o, 100, pm.PULL_TASK_ARGS, results)
+        )
+        time.sleep(0.02)
+    f2.release.set()
+    for t in threads:
+        t.join(10)
+    assert f2.order[1:] == [o.binary() for o in oids[1:]], "FIFO violated"
+
+
+def test_pull_dedup_follower_rides_leader():
+    """Concurrent pulls of ONE object cross the wire once: the second
+    request follows the active leader without charging budget."""
+    from ray_tpu._private.object_plane import pull_manager as pm
+
+    class _Store:
+        def contains(self, oid):
+            return True
+
+    f = _BlockingFetcher()
+    mgr = pm.PullManager(f, store=_Store(), budget_bytes=100)
+    (a,) = _mk_oids(1)
+    results = {}
+    t1 = _pull_in_thread(mgr, a, 100, pm.PULL_GET, results)
+    deadline = time.time() + 5
+    while not f.order and time.time() < deadline:
+        time.sleep(0.01)
+
+    follower_done = []
+
+    def follow():
+        follower_done.append(
+            mgr.pull(a, "addr", size=100, priority=pm.PULL_GET, timeout=10)
+        )
+
+    t2 = threading.Thread(target=follow, daemon=True)
+    t2.start()
+    time.sleep(0.1)
+    assert mgr.stats()["in_flight_bytes"] == 100  # charged once
+    f.release.set()
+    t1.join(10)
+    t2.join(10)
+    assert f.order == [a.binary()]  # one wire fetch
+    assert follower_done == [True]
+
+
+def test_pull_task_arg_class_context():
+    """The worker runtime scopes arg-resolution pulls to the task-args
+    class via the thread-local context."""
+    from ray_tpu._private.object_plane import pull_manager as pm
+
+    assert pm.current_pull_class() == pm.PULL_GET
+    with pm.pull_class(pm.PULL_TASK_ARGS):
+        assert pm.current_pull_class() == pm.PULL_TASK_ARGS
+        with pm.pull_class(pm.PULL_WAIT):
+            assert pm.current_pull_class() == pm.PULL_WAIT
+        assert pm.current_pull_class() == pm.PULL_TASK_ARGS
+    assert pm.current_pull_class() == pm.PULL_GET
