@@ -2,24 +2,58 @@
 # .buildkite/ + ci/ — here one deterministic make surface: native
 # build, bytecode lint, stress binaries, full suite).
 
-.PHONY: ci native lint test obs-smoke envelope-smoke chaos-smoke \
-	failover-smoke pressure-smoke stress clean
+.PHONY: ci native lint raylint raylint-baseline race-smoke test \
+	obs-smoke envelope-smoke chaos-smoke failover-smoke \
+	pressure-smoke stress clean
 
 ci: native lint test obs-smoke envelope-smoke chaos-smoke failover-smoke \
-	pressure-smoke
+	pressure-smoke race-smoke
 
 native:
 	$(MAKE) -C native
 
-# No flake8/pyflakes in this image: compileall catches syntax errors in
-# every module (including ones the suite never imports) and -W error
-# on import smoke-checks the public surface.
+# Three lint layers: compileall catches syntax errors in every module
+# (including ones the suite never imports), the import line smoke-
+# checks the public surface, and raylint enforces the runtime's
+# concurrency/reliability invariants (thread domains, one retry
+# policy, at-least-once GCS traffic, counted-never-silent faults, the
+# event-name registry) against tools/raylint/baseline.json —
+# pre-existing debt is tracked, NEW violations fail CI. See README
+# "Static analysis & concurrency invariants".
 lint:
-	python -m compileall -q ray_tpu tests
+	python -m compileall -q ray_tpu tests tools
+	python -m tools.raylint
 	JAX_PLATFORMS=cpu python -c "import ray_tpu, ray_tpu.data, \
 	ray_tpu.train, ray_tpu.tune, ray_tpu.serve, ray_tpu.rllib, \
 	ray_tpu.workflow, ray_tpu.dag, ray_tpu.autoscaler.gce, \
 	ray_tpu.util.multiprocessing, ray_tpu.experimental.tqdm_ray"
+
+# raylint alone (fast; no jax import needed).
+raylint:
+	python -m tools.raylint
+
+# Re-snapshot the accepted debt after deliberately fixing or accepting
+# violations. Review the diff of tools/raylint/baseline.json!
+raylint-baseline:
+	python -m tools.raylint --write-baseline
+
+# Lock-order witness soak (Python TSan-lite): the full witness unit
+# suite (inverted pair caught, clean ordering clean, reentrant RLock
+# no-false-positive) plus the object-plane, chaos, lifetime, and
+# actors suites with every threading.Lock/RLock wrapped and the
+# held-before graph checked for cycles. A witnessed inversion FAILS the run (pytest exit 3 from the
+# sessionfinish hook) even when every test passed — the inversion is a
+# deadlock waiting for production traffic to align. Subprocesses
+# (heads/raylets/workers) inherit RAY_TPU_lock_witness and
+# self-install; their findings append to the shared sidecar file the
+# sessionfinish gate scans (plus stderr and CHAOS LOCK_ORDER
+# flight-recorder events), so a daemon-side inversion fails the run
+# too. Skips are counted by pytest, never silent.
+race-smoke:
+	RAY_TPU_lock_witness=1 JAX_PLATFORMS=cpu python -m pytest \
+		tests/test_lock_witness.py tests/test_object_plane.py \
+		tests/test_chaos.py tests/test_object_lifetime.py \
+		tests/test_actors.py -q -p no:cacheprovider
 
 test:
 	python -m pytest tests/ -q

@@ -22,6 +22,17 @@ from __future__ import annotations
 
 import inspect as _inspect
 
+# Lock-order witness (RAY_TPU_lock_witness=1): stdlib-only module,
+# installed BEFORE the runtime imports below so the module-level locks
+# they create (events recorder lock, fastpath/native-store lib locks,
+# ...) are witnessed too. No-op unless the env opt-in is set; every
+# process that imports ray_tpu — driver, head, raylet, zygote, worker
+# — passes through here first, so one inherited env var arms the
+# whole tree with one shared enabled() predicate.
+from ._private import lock_witness as _lock_witness
+
+_lock_witness.maybe_install()
+
 from ._private.worker import (  # noqa: F401
     available_resources,
     client_server_address,

@@ -78,6 +78,12 @@ class CoreClient:
         self._closing = False
         self._reconnecting = False
         self._reconnect_lock = threading.Lock()
+        # Leased-task resubmits that hit ConnectionLost park here for
+        # ONE drainer thread (threads-per-lost-lease would balloon
+        # during the exact mass-lease-death outage this serves).
+        self._resubmit_pending: List[Any] = []
+        self._resubmit_lock = threading.Lock()
+        self._resubmit_thread: Optional[threading.Thread] = None
         self._conn_gen = 0
         #: Set when the head is gone for good (reconnect disabled,
         #: budget exhausted, or close()): watchers exit on this.
@@ -488,6 +494,7 @@ class CoreClient:
                 if not self._await_failover():
                     raise
 
+    # raylint: dispatch-only
     def _on_push(self, msg: Dict[str, Any]):
         if type(msg) is tuple and msg[0] == "RDY":
             self._wait_mark(msg[1], subscribed=True)
@@ -920,15 +927,11 @@ class CoreClient:
             self._leased_conn_lost(lease, spec, oids, delivered=False)
             return refs
         self._mark_lazy(conn)
-        if conn.closed:
-            # Closed between claim and push: send_lazy only buffers, so
-            # nothing raised — and the reader that would fail our
-            # future may have died before it was registered. Resolve
-            # through the conn-lost path instead of leaving a
-            # forever-pending future (the 1-in-200k lost-task wedge);
+        if conn.closed_after_push(req_id):
+            # Closed between claim and push (the 1-in-200k lost-task
+            # wedge): resolve through the conn-lost path;
             # delivered=True keeps at-most-once semantics in case the
             # frame flushed before the close landed.
-            conn.drop_future(req_id)
             self._leased_conn_lost(lease, spec, oids, delivered=True)
             return refs
         rfut.add_done_callback(
@@ -977,10 +980,70 @@ class CoreClient:
         for ob in oids:
             self._direct_results[ob] = {"via_gcs": True}
         try:
+            # Fast path: head healthy, the resubmit lands instantly.
+            # raylint: disable=raw-send-on-gcs-path -- ConnectionLost hands off to send_reliable on a side thread below; a raw drop here previously lost the resubmit across a head failover (the _report_done bug class)
             self.conn.send({"type": "submit_task", "spec": spec})
         except ConnectionLost:
-            pass
+            # Head mid-failover. send_reliable parks until the
+            # reconnect lands — but THIS code often runs on the dying
+            # leased conn's reader thread (close-sweep future
+            # callbacks), and parking that thread would stall the
+            # sweep's remaining futures for the whole outage window.
+            # Queue for the single drainer thread (same pattern as
+            # PR 4 moving _report_done onto the batcher thread); many
+            # leases die together in a failover, so thread-per-spec
+            # would balloon exactly when the process is degraded.
+            self._queue_resubmit(spec)
         self._wait_on_failure(oids)
+
+    def _queue_resubmit(self, spec):
+        with self._resubmit_lock:
+            self._resubmit_pending.append(spec)
+            t = self._resubmit_thread
+            if t is not None and t.is_alive():
+                return
+            self._resubmit_thread = threading.Thread(
+                target=self._drain_resubmits, name="resubmit-reliable",
+                daemon=True,
+            )
+            self._resubmit_thread.start()
+
+    def _drain_resubmits(self):
+        while True:
+            with self._resubmit_lock:
+                if not self._resubmit_pending:
+                    self._resubmit_thread = None
+                    return
+                spec = self._resubmit_pending.pop(0)
+            try:
+                self.send_reliable({"type": "submit_task", "spec": spec})
+            except ConnectionLost:
+                # Head permanently lost: the session is over; gets
+                # fail through the head-loss path — drop the rest,
+                # counted (a post-mortem must be able to tell a mass
+                # resubmit discard from tasks never resubmitted).
+                with self._resubmit_lock:
+                    dropped = len(self._resubmit_pending) + 1
+                    del self._resubmit_pending[:]
+                    self._resubmit_thread = None
+                if _events.enabled():
+                    _events.record(
+                        _events.HEAD, self.worker_id.hex()[:12],
+                        "RESUBMITS_DROPPED", {"count": dropped},
+                    )
+                return
+            except Exception as e:
+                # Anything else (say, a spec that fails to serialize
+                # on the reconnected conn) must not kill the drainer
+                # with specs still queued behind it — count and drop
+                # THIS spec, keep draining; its gets fail through the
+                # normal result paths.
+                if _events.enabled():
+                    _events.record(
+                        _events.HEAD, self.worker_id.hex()[:12],
+                        "RESUBMITS_DROPPED",
+                        {"count": 1, "error": type(e).__name__},
+                    )
 
     def _dec_lease(self, lease):
         with self._lease_lock:
@@ -1091,6 +1154,15 @@ class CoreClient:
             self._on_direct_close(aid)
             return refs
         self._mark_lazy(conn)
+        if conn.closed_after_push(req_id):
+            # Closed between the route lookup and this push (an actor
+            # kill's async death cleanup racing the very next call);
+            # found by the lock witness's timing perturbation in
+            # test_kill_actor.
+            for d in dep_ids:
+                self._tracker.decr(d)
+            self._on_direct_close(aid)
+            return refs
 
         def _resolved(f, oids=oids, aid=aid, dep_ids=dep_ids):
             for d in dep_ids:

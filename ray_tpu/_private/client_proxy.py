@@ -291,7 +291,9 @@ class _Session:
             fields["segment"] = self.core.store.put_packed(oid, blob)
         if msg.get("children"):
             fields["children"] = msg["children"]
-        reply = self.core.conn.request({"type": "put_object", **fields})
+        # request_reliable: a proxy put must survive a head failover
+        # like a direct client's put does (raylint raw-send-on-gcs-path).
+        reply = self.core.request_reliable({"type": "put_object", **fields})
         if not reply.get("ok"):
             raise RayTpuError(f"proxy put failed: {reply}")
         self.core._tracker.mark_advertised(oid.binary())

@@ -21,7 +21,16 @@ Tables owned here:
   - node table + resource view (total/available per node)
   - placement groups: bundles reserved against node resources
   - internal KV
+
+Every ``_h_*`` method is a dispatch-thread message handler: at task-
+storm rates the dispatch loop is the cluster's throughput bottleneck,
+so nothing reachable from a handler may sleep, do file/socket IO, or
+mutate the object plane's guarded refcount state (raylint
+no-blocking-on-dispatch / thread-domain enforce both statically; the
+GUARD hook in object_plane/directory.py enforces the latter at
+runtime in tests).
 """
+# raylint: dispatch-handlers=_h_*
 from __future__ import annotations
 
 import math
@@ -1641,6 +1650,7 @@ class GcsServer:
             ]
         for conn in daemons:
             try:
+                # raylint: disable=raw-send-on-gcs-path -- head->daemon push: a lost conn means the daemon died and its store (holding the freed copies) died with it
                 conn.send({"type": "free_objects", "object_ids": freed})
             except ConnectionLost:
                 pass

@@ -20,6 +20,12 @@ import time
 
 
 def main(argv=None) -> int:
+    # Lock-order witness (RAY_TPU_lock_witness=1, race-smoke): install
+    # BEFORE the runtime constructs its locks so head-side lock
+    # acquisition orders are witnessed too.
+    from . import lock_witness
+
+    lock_witness.maybe_install()
     parser = argparse.ArgumentParser(description="ray_tpu standalone head")
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--tcp-port", type=int, required=True)

@@ -28,7 +28,13 @@ Flap/suppression invariants (regression-tested):
 - a remove/bdel/release is only sent after its add (or for owner
   returns, after submission advertised the entry), so a bare removal
   can never race ahead of the state it retracts.
+
+Thread domain (raylint-enforced): every mutation of the guarded
+bookkeeping declared below happens in a ``# raylint: applier-only``
+method, all of which hold ``self._lock`` — the tracker's equivalent
+of the directory's single applier thread.
 """
+# raylint: guarded-attrs=_counts,_owner_of,_dirty,_zeroed,_advertised,_borrows,_unacked,_dead_borrowers
 from __future__ import annotations
 
 import threading
@@ -114,6 +120,7 @@ class OwnerRefTracker:
 
     # ------------------------------------------------------------- tracking
 
+    # raylint: applier-only
     def incr(self, oid: bytes, owner: bytes = b"") -> None:
         with self._lock:
             n = self._counts.get(oid, 0) + 1
@@ -127,6 +134,7 @@ class OwnerRefTracker:
                 self._zeroed.discard(oid)
                 self._ensure_flusher()
 
+    # raylint: applier-only
     def decr(self, oid: bytes) -> None:
         with self._lock:
             n = self._counts.get(oid, 0) - 1
@@ -147,6 +155,7 @@ class OwnerRefTracker:
         with self._lock:
             return self._owner_of.get(oid, b"")
 
+    # raylint: applier-only
     def mark_advertised(self, oid: bytes) -> None:
         """The remote side already records this oid's presence here:
         the head holds the entry for owner return-refs/puts from birth,
@@ -155,11 +164,13 @@ class OwnerRefTracker:
         with self._lock:
             self._advertised.add(oid)
 
+    # raylint: applier-only
     def mark_owned(self, oid: bytes) -> None:
         """Force owner classification (refs this process created)."""
         with self._lock:
             self._owner_of[oid] = self._self_id
 
+    # raylint: applier-only
     def forget(self, oids) -> None:
         """Explicit free(): drop all bookkeeping so the instances still
         alive cannot emit retractions for an entry already gone."""
@@ -174,6 +185,7 @@ class OwnerRefTracker:
 
     # ---------------------------------------------------- borrow authority
 
+    # raylint: applier-only
     def apply_borrow_update(self, borrower: bytes, add, remove) -> None:
         """Head-relayed borrow edges for objects this process owns."""
         requeue = False
@@ -206,6 +218,7 @@ class OwnerRefTracker:
         if requeue:
             self._ensure_flusher()
 
+    # raylint: applier-only
     def on_reconnect(self) -> Dict[bytes, List[bytes]]:
         """The head restarted and this client re-registered on a fresh
         connection. Three things must replay (the head's per-conn
@@ -250,6 +263,7 @@ class OwnerRefTracker:
         self._ensure_flusher()
         return owned
 
+    # raylint: applier-only
     def sweep_borrower(self, borrower: bytes) -> None:
         """A borrowing process died without retracting its borrows."""
         requeue = False
@@ -275,6 +289,7 @@ class OwnerRefTracker:
 
     # ------------------------------------------------------------- flushing
 
+    # raylint: applier-only
     def _maybe_renumber_locked(self) -> None:
         """Caller holds self._lock. Renumber unacked batches 1..k
         (original order, due immediately) when the client moved to a
@@ -331,6 +346,7 @@ class OwnerRefTracker:
                 return
             self.flush(client)
 
+    # raylint: applier-only
     def _classify(
         self
     ) -> Tuple[List[bytes], List[Tuple[bytes, bytes]],
@@ -386,6 +402,7 @@ class OwnerRefTracker:
                 self._owner_of.pop(oid, None)
         return release, badd, bdel, add, remove, dirty
 
+    # raylint: applier-only
     def flush(self, client) -> None:
         """Send the net ownership-edge transitions since the last
         flush (idempotent set semantics server-side, so transient
@@ -448,6 +465,7 @@ class OwnerRefTracker:
         # batch never reaches the head.
         _chaos.kill_point("owner.pre_ref_flush")
         try:
+            # raylint: disable=raw-send-on-gcs-path -- this IS the at-least-once layer: the batch is retained in _unacked above and retransmits until the head acks
             client.conn.send(msg)
         except ConnectionLost:
             # The batch stays in _unacked; it retransmits on the next
@@ -458,12 +476,14 @@ class OwnerRefTracker:
             return
         self._retransmit_due(client)
 
+    # raylint: applier-only
     def ack(self, seq: int) -> None:
         """Head acknowledged a ref_flush batch (delivered to its
         per-conn sequencer; idempotent application from there)."""
         with self._lock:
             self._unacked.pop(seq, None)
 
+    # raylint: applier-only
     def _retransmit_due(self, client) -> None:
         """Resend unacked batches past the retransmit age; bounded
         attempts, lost batches counted — never silent."""

@@ -170,6 +170,24 @@ class PeerConn:
                 self._pending.pop(req_id, None)
             raise ConnectionLost("peer connection closed")
 
+    def closed_after_push(self, req_id: int) -> bool:
+        """``send_lazy`` twin of ``_check_open_for_request``: buffered
+        pushes raise nothing, so a conn that closed between the route
+        lookup and the push leaves the reply future registered AFTER
+        the reader's close sweep — and ``flush_lazy`` skips closed
+        conns, so the frame never ships and the future pends forever.
+        Every send_lazy-with-reply call site must call this after the
+        push (the reader sets ``_closed`` before sweeping, so a False
+        here guarantees a later close WILL fail the already-registered
+        future). On True the future is dropped; the caller resolves
+        through its conn-lost path. Note the frame MAY still have
+        flushed before the close landed — callers keep at-most-once
+        semantics (delivered=True)."""
+        if self._closed.is_set():
+            self.drop_future(req_id)
+            return True
+        return False
+
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Any:
         """Send and block for the correlated reply; returns reply dict.
 
@@ -210,6 +228,7 @@ class PeerConn:
 
     # ---------------------------------------------------------------- receive
 
+    # raylint: dispatch-only
     def _deliver(self, msg: Any) -> None:
         if type(msg) is tuple and msg[0] == "B":
             # Coalesced envelope: chaos (and delivery) act per inner
@@ -231,6 +250,7 @@ class PeerConn:
         for m in sched.intercept(self, mtype, msg):
             self._deliver_one(m)
 
+    # raylint: dispatch-only
     def _deliver_one(self, msg: Any) -> None:
         if type(msg) is tuple:
             op = msg[0]

@@ -899,6 +899,9 @@ def bench_chaos_soak(cfg: Dict[str, float]):
     def traffic(idx: int):
         lrng = random.Random(seed ^ (idx + 1))
         base = np.ones(payload_n)
+        # One retry policy (raylint fixed-sleep-retry): seeded jittered
+        # backoff de-correlates the traffic threads across kill windows.
+        bo = _chaos.Backoff(base_s=0.1, cap_s=1.0, rng=lrng)
         while not stop.is_set():
             try:
                 ref = ray_tpu.put(base * lrng.random())
@@ -907,17 +910,19 @@ def bench_chaos_soak(cfg: Dict[str, float]):
                 out = ray_tpu.get(r2, timeout=get_timeout)
                 assert len(out) > 0
                 stats["ok"] += 1
+                bo.reset()
                 del ref, r1, r2, out
             except GetTimeoutError as e:
                 wedged.append(f"traffic[{idx}]: {e}")
                 return
             except Exception:  # noqa: BLE001 - kills make failures legal
                 stats["failed"] += 1
-                time.sleep(0.1)
+                bo.sleep()
 
     def keeper_loop():
         k = _ChaosKeeper.remote()
         n = 0
+        bo = _chaos.Backoff(base_s=0.2, cap_s=1.0, rng=random.Random(seed))
         while not stop.is_set():
             try:
                 refs = [ray_tpu.put(np.arange(4096.0)) for _ in range(4)]
@@ -925,17 +930,18 @@ def bench_chaos_soak(cfg: Dict[str, float]):
                 del refs
                 ray_tpu.get(k.read.remote(), timeout=get_timeout)
                 stats["keeper_ok"] += 1
+                bo.reset()
                 n += 1
                 if n % 7 == 0:
                     # Actor restart racing the borrower_died sweep.
                     k.die.remote()
-                    time.sleep(0.5)
+                    time.sleep(0.5)  # settle after the intentional kill
             except GetTimeoutError as e:
                 wedged.append(f"keeper: {e}")
                 return
             except Exception:  # noqa: BLE001
                 stats["failed"] += 1
-                time.sleep(0.2)
+                bo.sleep()
         try:
             ray_tpu.kill(k)
         except Exception:  # noqa: BLE001
@@ -1187,6 +1193,7 @@ def bench_head_failover(cfg: Dict[str, float]):
         def traffic(idx: int):
             lrng = random.Random(seed ^ (idx + 1))
             base = np.ones(payload_n)
+            bo = _chaos.Backoff(base_s=0.2, cap_s=1.5, rng=lrng)
             while not stop.is_set():
                 try:
                     ref = ray_tpu.put(base * lrng.random())
@@ -1195,15 +1202,17 @@ def bench_head_failover(cfg: Dict[str, float]):
                     out = ray_tpu.get(r2, timeout=get_timeout)
                     assert len(out) > 0
                     stats["ok"] += 1
+                    bo.reset()
                     del ref, r1, r2, out
                 except GetTimeoutError as e:
                     _attribute_wedge(f"traffic[{idx}]", r2, e)
                     return
                 except Exception:  # noqa: BLE001 - kills make failures legal
                     stats["failed"] += 1
-                    time.sleep(0.2)
+                    bo.sleep()
 
         def actor_loop():
+            bo = _chaos.Backoff(base_s=0.3, cap_s=2.0, rng=random.Random(seed))
             while not stop.is_set():
                 ref = None
                 try:
@@ -1211,13 +1220,14 @@ def bench_head_failover(cfg: Dict[str, float]):
                     n = ray_tpu.get(ref, timeout=get_timeout)
                     assert n >= 1
                     stats["actor_ok"] += 1
-                    time.sleep(0.2)
+                    bo.reset()
+                    time.sleep(0.2)  # pacing between successful calls
                 except GetTimeoutError as e:
                     _attribute_wedge("actor", ref, e)
                     return
                 except Exception:  # noqa: BLE001 - restart window
                     stats["failed"] += 1
-                    time.sleep(0.3)
+                    bo.sleep()
 
         threads = [
             threading.Thread(target=traffic, args=(i,), daemon=True)

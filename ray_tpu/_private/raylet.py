@@ -200,6 +200,7 @@ class NodeDaemon:
 
     # --------------------------------------------------------------- pushes
 
+    # raylint: dispatch-only
     def _on_push(self, msg):
         mtype = msg.get("type")
         if mtype == "spawn_worker":
@@ -646,6 +647,12 @@ class NodeDaemon:
 
 
 def main(argv=None):
+    # Lock-order witness opt-in (env-inherited from the test driver):
+    # install BEFORE the daemon builds its lock domains so raylet-side
+    # orders (lease pool, heartbeat, transfer server) are witnessed.
+    from . import lock_witness
+
+    lock_witness.maybe_install()
     parser = argparse.ArgumentParser(description="ray_tpu node daemon")
     parser.add_argument("--address", required=True, help="head GCS host:port")
     parser.add_argument("--authkey", default=None, help="cluster auth key (hex)")

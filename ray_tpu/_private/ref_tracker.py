@@ -146,7 +146,18 @@ class LegacyRefTracker:
             time.sleep(FLUSH_INTERVAL_S)
             self._wake.clear()
             client = self._client()
-            if client is None or client.conn.closed:
+            if client is None:
+                return
+            if client.conn.closed:
+                # Head outage: if a failover reconnect may still land,
+                # stay alive — the re-dirtied edges flush after the
+                # swap (mirrors OwnerRefTracker._flush_loop).
+                if getattr(
+                    client, "conn_failover_pending", lambda: False
+                )():
+                    self._wake.set()
+                    time.sleep(FLUSH_INTERVAL_S)
+                    continue
                 return
             self.flush(client)
 
@@ -163,6 +174,14 @@ class LegacyRefTracker:
                 for oid in dirty
                 if self._counts.get(oid, 0) <= 0 and oid in self._advertised
             ]
+            # adds may include oids the head already records (re-adds
+            # are idempotent); the ConnectionLost revert below must
+            # only un-advertise what THIS flush newly advertised, or a
+            # pre-advertised oid's eventual remove would be suppressed
+            # and the head would keep a phantom holder forever.
+            newly_advertised = [
+                oid for oid in add if oid not in self._advertised
+            ]
             self._advertised.update(add)
             self._advertised.difference_update(remove)
             zeroed, self._zeroed = self._zeroed, set()
@@ -175,6 +194,7 @@ class LegacyRefTracker:
         from .protocol import ConnectionLost
 
         try:
+            # raylint: disable=raw-send-on-gcs-path -- reverted and re-dirtied on ConnectionLost below; the next flush after a failover resends (idempotent 0/1 set semantics head-side)
             client.conn.send(
                 {
                     "type": "update_refs",
@@ -184,7 +204,29 @@ class LegacyRefTracker:
                 }
             )
         except ConnectionLost:
-            self._stopped = True
+            with self._lock:
+                # The head never saw this batch: revert the advertised
+                # state (only the edges this flush introduced) and
+                # re-dirty the oids so a flush on a future reconnected
+                # transport re-sends the edges instead of losing them
+                # (swallowed-ConnectionLost bug class).
+                self._advertised.difference_update(newly_advertised)
+                self._advertised.update(remove)
+                self._dirty.update(add)
+                self._dirty.update(remove)
+                # Re-arm the flusher: incr/decr only set the wake on
+                # the empty->dirty edge, which can never fire again now
+                # that _dirty is non-empty — without this the loop
+                # parks in _wake.wait() forever and the re-dirtied
+                # edges never resend.
+                self._wake.set()
+            # CoreClient transports may have a failover landing;
+            # transports without the hook (the ray_tpu:// proxy) have
+            # no reconnect story, so the tracker stops as before.
+            if not getattr(
+                client, "conn_failover_pending", lambda: False
+            )():
+                self._stopped = True
 
     def stop(self):
         self._stopped = True

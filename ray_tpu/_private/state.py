@@ -1,6 +1,12 @@
 """GlobalState helpers: timeline export (reference:
 python/ray/_private/state.py — ray.timeline :942 dumps chrome://tracing
-JSON from the GCS task-event store)."""
+JSON from the GCS task-event store).
+
+Every event-name literal this module stitches against is checked
+against _private/event_names.py by raylint (the module marker below):
+a renamed event fails the lint instead of silently vanishing from the
+timeline."""
+# raylint: check-event-literals
 from __future__ import annotations
 
 import json

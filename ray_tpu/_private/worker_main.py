@@ -1191,6 +1191,10 @@ class WorkerRuntime:
 
 
 def main():
+    # Lock-order witness opt-in (env-inherited from the test driver).
+    from . import lock_witness
+
+    lock_witness.maybe_install()
     address = os.environ["RAY_TPU_SESSION_ADDR"]
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
@@ -1215,27 +1219,44 @@ def main():
     task_queue: "queue.Queue" = queue.Queue()
     rt_holder: Dict[str, Any] = {}
 
+    # raylint: dispatch-only
     def push(msg):
         t = msg["type"]
         def _send_stack_reply(token, text, **extra):
+            def _send():
+                try:
+                    rt_holder["boot_client"].send(
+                        {
+                            "type": "stack_dump", "token": token,
+                            "text": text, **extra,
+                        }
+                    )
+                except Exception:  # noqa: BLE001 - reply is best-effort
+                    pass
+
+            if "boot_client" in rt_holder:
+                _send()
+                return
+
             # A dump can race CoreClient construction (the GCS learns
-            # of this worker during the handshake); wait briefly for
-            # main() to publish the client.
-            deadline = time.monotonic() + 2.0
-            while (
-                "boot_client" not in rt_holder
-                and time.monotonic() < deadline
-            ):
-                time.sleep(0.01)
-            try:
-                rt_holder["boot_client"].send(
-                    {
-                        "type": "stack_dump", "token": token,
-                        "text": text, **extra,
-                    }
-                )
-            except Exception:  # noqa: BLE001
-                pass
+            # of this worker during the handshake). The wait for
+            # main() to publish the client moves OFF the reader
+            # thread: spinning here would stall execute_task delivery
+            # for up to 2s (raylint no-blocking-on-dispatch).
+            def _wait_and_send():
+                deadline = time.monotonic() + 2.0
+                while (
+                    "boot_client" not in rt_holder
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.01)
+                if "boot_client" in rt_holder:
+                    _send()
+
+            threading.Thread(
+                target=_wait_and_send, name="stack-dump-reply",
+                daemon=True,
+            ).start()
 
         if t == "execute_task":
             s = msg["spec"]

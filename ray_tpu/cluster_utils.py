@@ -184,7 +184,12 @@ class SupervisedHead:
                     return
             # Relaunch on the same address/session: persisted tables
             # restore; everyone reconnects. A port still draining from
-            # the old process retries briefly.
+            # the old process retries briefly — on the one retry
+            # policy (chaos.Backoff: jittered, capped), not a fixed
+            # sleep (raylint fixed-sleep-retry).
+            from ._private.chaos import Backoff
+
+            bo = Backoff(base_s=0.5, cap_s=4.0)
             for attempt in range(5):
                 try:
                     self._start_head()
@@ -192,7 +197,7 @@ class SupervisedHead:
                 except (RuntimeError, TimeoutError, OSError):
                     if attempt == 4:
                         return  # supervisor gives up: head stays dead
-                    time.sleep(0.5)
+                    bo.sleep()
             with self._lock:
                 if self._stopping:
                     return

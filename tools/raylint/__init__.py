@@ -1,0 +1,25 @@
+"""raylint: AST static analysis enforcing the runtime's concurrency and
+reliability invariants (thread domains, one retry policy, at-least-once
+GCS traffic, counted-never-silent faults, the event-name registry).
+
+Run from the repo root:
+
+    python -m tools.raylint                 # check against the baseline
+    python -m tools.raylint --write-baseline  # re-snapshot the debt
+    python -m tools.raylint --only fixed-sleep-retry ray_tpu/_private
+
+See tools/raylint/markers.py for the ``# raylint:`` marker grammar and
+the README "Static analysis & concurrency invariants" section for the
+rule catalogue and the baseline workflow.
+"""
+from .engine import (  # noqa: F401
+    RULES,
+    FileContext,
+    Violation,
+    diff_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+from . import rules  # noqa: F401 - registers the rule catalogue
