@@ -4,10 +4,10 @@
 
 .PHONY: ci native lint raylint raylint-baseline race-smoke test \
 	obs-smoke envelope-smoke chaos-smoke failover-smoke \
-	pressure-smoke stress clean
+	pressure-smoke shm-smoke stress clean
 
 ci: native lint test obs-smoke envelope-smoke chaos-smoke failover-smoke \
-	pressure-smoke race-smoke
+	pressure-smoke race-smoke shm-smoke
 
 native:
 	$(MAKE) -C native
@@ -124,6 +124,16 @@ pressure-smoke:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
 		--only pressure_soak --pressure-smoke \
 		--out /tmp/ray_tpu_pressure_smoke.json
+
+# Shared-memory object plane smoke: the node-pool crash-safety suite
+# (multi-process bit-exactness, SIGKILL ledger sweep, mid-put partial
+# reclamation, cross-process eviction pinning, pool-full -> segment
+# ladder) plus the allocator/refcount unit tests. On a host without
+# /dev/shm or the C++ toolchain the suite SKIPS each test with a
+# counted reason (pytest's skip column) — never silently green.
+shm-smoke:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_shm_plane.py \
+		tests/test_native_store.py -q -p no:cacheprovider -rs
 
 stress:
 	$(MAKE) -C native stress-asan

@@ -7,7 +7,7 @@
 // objects. This is the same design collapsed into one shm pool shared
 // by every process on the node:
 //
-//   [Header | object table (open addressing) | arena]
+//   [Header | client slots | client ledgers | object table | arena]
 //
 // All cross-process state lives in the pool; a robust process-shared
 // pthread mutex guards the table + allocator, so a crashed worker can
@@ -20,9 +20,22 @@
 // (header+footer per block, explicit doubly-linked free list,
 // first-fit with splitting and bidirectional coalescing), 64-byte
 // alignment so payloads are cache-line- and dlpack-friendly.
+//
+// Client registry (v2): every attaching process registers a client slot
+// {pid, generation} and its refcount mutations are double-entried into a
+// per-client ledger (open-addressed, keyed by object-table slot). The
+// reference's plasma store gets disconnect sweeps for free because each
+// client holds a unix socket to the store and EOF triggers
+// ReleaseClientResources; with direct shm attach there is no socket, so
+// the sweep walks the registry, probes liveness with kill(pid, 0), and
+// subtracts a dead client's ledger from the global refcounts — including
+// reclaiming its mid-write (created-not-sealed) objects, which must
+// never seal. A full ledger counts overflow events (counted, never
+// silent) and those refs stay pinned until pool destroy.
 
 #include <algorithm>
 #include <cerrno>
+#include <csignal>
 #include <cstdio>
 #include <cstdint>
 #include <cstring>
@@ -36,12 +49,16 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545055504F4F4CULL;  // "RTPUPOOL"
+// v2 layout (client registry + ledgers). Bumped from the v1 value so an
+// old .so can never attach a new pool (or vice versa) and misread the
+// table: attach checks magic and fails cleanly.
+constexpr uint64_t kMagic = 0x52545055504F4F32ULL;  // "RTPUPOO2"
 constexpr uint64_t kNull = ~0ULL;
 constexpr uint64_t kAlign = 64;
 constexpr uint64_t kBlockHeader = 16;  // [size u64][flags u64]
 constexpr uint64_t kBlockFooter = 8;   // [size u64]
 constexpr uint64_t kMinBlock = 128;
+constexpr uint32_t kMaxClients = 256;
 constexpr uint32_t kStateEmpty = 0;
 constexpr uint32_t kStateCreated = 1;
 constexpr uint32_t kStateSealed = 2;
@@ -57,6 +74,27 @@ struct Entry {
   uint32_t state;
   int32_t refcount;
   uint64_t lru;
+  uint32_t creator;  // client slot + 1; 0 = unregistered creator
+  uint32_t _pad;
+};
+
+struct ClientSlot {
+  int32_t pid;
+  uint32_t state;  // 0 free, 1 active
+  uint64_t generation;
+  uint32_t overflow;  // refs this client could not ledger (counted)
+  uint32_t _pad;
+  uint64_t _pad2;
+};
+
+// One per-client ledger cell: key = object-table slot + 1 (0 = empty),
+// count = refs this client holds on that entry. A cell whose count
+// dropped to 0 keeps its key but is reusable by any insert — losing a
+// zero-count key carries no information, and probes only stop on key==0,
+// so chains through reused cells stay reachable.
+struct LedgerEntry {
+  uint32_t key;
+  uint32_t count;
 };
 
 struct Header {
@@ -72,17 +110,29 @@ struct Header {
   pthread_mutex_t mutex;
   uint64_t lru_clock;
   uint64_t free_head;  // arena-relative offset of first free block
+  // client registry
+  uint64_t clients_offset;
+  uint64_t ledgers_offset;
+  uint32_t max_clients;
+  uint32_t ledger_cap;  // cells per client ledger
+  uint64_t generation;  // monotonically increasing client registrations
   // stats
   uint64_t bytes_in_use;
   uint64_t num_objects;
   uint64_t num_evictions;
   uint64_t bytes_evicted;
+  uint64_t num_sweeps;
+  uint64_t refs_swept;
+  uint64_t partials_reclaimed;
+  uint64_t ledger_overflows;
 };
 
 struct Store {
   uint8_t* base;
   Header* h;
   uint64_t map_size;
+  int32_t client;  // this handle's registered client slot, -1 if none
+  int32_t pid;     // pid recorded at registration (slot-reuse guard)
   char name[256];
 };
 
@@ -90,6 +140,13 @@ inline Entry* table(Store* s) {
   return reinterpret_cast<Entry*>(s->base + s->h->table_offset);
 }
 inline uint8_t* arena(Store* s) { return s->base + s->h->arena_offset; }
+inline ClientSlot* clients(Store* s) {
+  return reinterpret_cast<ClientSlot*>(s->base + s->h->clients_offset);
+}
+inline LedgerEntry* ledger(Store* s, uint32_t client) {
+  return reinterpret_cast<LedgerEntry*>(s->base + s->h->ledgers_offset) +
+         (uint64_t)client * s->h->ledger_cap;
+}
 
 // ---------------------------------------------------------------- blocks
 // Block layout: [size u64][flags u64][payload ...][size u64]
@@ -212,7 +269,122 @@ void free_entry(Store* s, Entry* e) {
   arena_free(s, e->offset);
   e->state = kStateTombstone;
   e->offset = kNull;
+  e->creator = 0;
   s->h->num_objects--;
+}
+
+// --------------------------------------------------------------- ledgers
+// Double-entry of this handle's refcount mutations, so a dead client's
+// refs can be subtracted back out. Called with the pool mutex held.
+void ledger_adjust(Store* s, Entry* e, int32_t delta) {
+  if (s->client < 0) return;
+  // Slot-reuse guard: after a sweep or a sibling handle's detach retired
+  // this slot (and possibly another process re-registered it), a stale
+  // handle must not touch the ledger — the global refcount alone stays
+  // correct for it.
+  ClientSlot* c = &clients(s)[s->client];
+  if (c->state != 1 || c->pid != s->pid) {
+    s->client = -1;
+    return;
+  }
+  uint32_t ti = (uint32_t)(e - table(s));
+  uint32_t key = ti + 1;
+  uint32_t cap = s->h->ledger_cap;
+  LedgerEntry* led = ledger(s, (uint32_t)s->client);
+  uint64_t idx = ((uint64_t)ti * 0x9E3779B1ULL) % cap;
+  LedgerEntry* reuse = nullptr;
+  for (uint32_t p = 0; p < cap; ++p) {
+    LedgerEntry* le = &led[(idx + p) % cap];
+    if (le->key == key) {
+      if (delta > 0) {
+        le->count += (uint32_t)delta;
+      } else if (le->count > 0) {
+        le->count--;
+      }
+      return;
+    }
+    if (le->key == 0) {
+      if (!reuse) reuse = le;
+      break;
+    }
+    if (le->count == 0 && !reuse) reuse = le;
+  }
+  if (delta <= 0) return;  // release of an untracked (overflowed) ref
+  if (reuse) {
+    reuse->key = key;
+    reuse->count = (uint32_t)delta;
+    return;
+  }
+  // Ledger full: the global refcount is still correct while this client
+  // lives, but the ref can't be swept if it dies. Counted, never silent.
+  clients(s)[s->client].overflow++;
+  s->h->ledger_overflows++;
+}
+
+// Subtract client `ci`'s ledger from the global refcounts and retire the
+// slot. Reclaims its mid-write (created, unsealed) objects — which must
+// never seal — and completes any deferred deletes its refs were pinning.
+// Called with the mutex held.
+uint64_t drain_client_locked(Store* s, uint32_t ci, uint64_t* partials) {
+  LedgerEntry* led = ledger(s, ci);
+  uint64_t dropped = 0;
+  for (uint32_t j = 0; j < s->h->ledger_cap; ++j) {
+    LedgerEntry* le = &led[j];
+    if (le->key == 0) continue;
+    if (le->count == 0) {
+      le->key = 0;
+      continue;
+    }
+    Entry* e = &table(s)[le->key - 1];
+    if (e->state != kStateEmpty && e->state != kStateTombstone) {
+      int32_t c = (int32_t)le->count;
+      e->refcount = e->refcount > c ? e->refcount - c : 0;
+      dropped += (uint64_t)c;
+      if (e->state == kStateCreated && e->creator == ci + 1) {
+        // Partial write by a dead creator: reclaim, never seal.
+        free_entry(s, e);
+        if (partials) (*partials)++;
+      } else if (e->refcount == 0 && e->state == kStateDeleting) {
+        free_entry(s, e);
+      }
+    }
+    le->key = 0;
+    le->count = 0;
+  }
+  ClientSlot* c = &clients(s)[ci];
+  c->state = 0;
+  c->pid = 0;
+  c->overflow = 0;
+  return dropped;
+}
+
+// Probe every registered client with kill(pid, 0); drain the dead ones.
+// EPERM means "exists, not ours" — only ESRCH is death. A recycled pid
+// pins refs until the recycled process also exits; conservative, never
+// frees early. Called with the mutex held.
+int32_t sweep_locked(Store* s, uint64_t* out4) {
+  int32_t swept = 0;
+  uint64_t refs = 0, partials = 0;
+  for (uint32_t i = 0; i < s->h->max_clients; ++i) {
+    ClientSlot* c = &clients(s)[i];
+    if (c->state != 1) continue;
+    if ((int32_t)i == s->client) continue;  // never sweep self
+    if (!(kill((pid_t)c->pid, 0) != 0 && errno == ESRCH)) continue;
+    refs += drain_client_locked(s, i, &partials);
+    swept++;
+  }
+  if (swept) {
+    s->h->num_sweeps++;
+    s->h->refs_swept += refs;
+    s->h->partials_reclaimed += partials;
+  }
+  if (out4) {
+    out4[0] = (uint64_t)swept;
+    out4[1] = refs;
+    out4[2] = partials;
+    out4[3] = s->h->ledger_overflows;
+  }
+  return swept;
 }
 
 // Evict sealed refcount-0 objects (LRU first) until at least `need`
@@ -242,15 +414,26 @@ uint64_t alloc_with_eviction(Store* s, uint64_t need) {
 extern "C" {
 
 // Create a new pool. Returns handle (opaque ptr) or 0 on failure.
-// evict_enabled=0 is the safe default for a session pool: nothing pins
-// client-referenced objects across processes yet, so eviction could free
-// data a live ObjectRef still names. With eviction off a full pool fails
-// the create and the caller falls back to per-object segments.
+// evict_enabled=0 is the safe default for a session pool: the spill
+// ladder (not LRU eviction) is what frees space, so a full pool fails
+// the create and the caller backpressures / falls back to per-object
+// segments.
 uint64_t store_create(const char* name, uint64_t pool_bytes,
                       uint32_t max_objects, int32_t evict_enabled) {
+  // Ledger capacity: enough cells that a well-behaved client (refs ≤
+  // objects it touches) rarely overflows, without dominating small test
+  // pools. 256 clients * 4096 cells * 8 B = 8 MiB at the default cap.
+  uint32_t ledger_cap = max_objects < 4096 ? max_objects : 4096;
+  if (ledger_cap < 16) ledger_cap = 16;
+  uint64_t clients_bytes =
+      round_up((uint64_t)kMaxClients * sizeof(ClientSlot), kAlign);
+  uint64_t ledgers_bytes = round_up(
+      (uint64_t)kMaxClients * ledger_cap * sizeof(LedgerEntry), kAlign);
   uint64_t table_bytes = round_up((uint64_t)max_objects * sizeof(Entry), kAlign);
   uint64_t header_bytes = round_up(sizeof(Header), kAlign);
-  uint64_t total = round_up(header_bytes + table_bytes + pool_bytes, 4096);
+  uint64_t total = round_up(
+      header_bytes + clients_bytes + ledgers_bytes + table_bytes + pool_bytes,
+      4096);
 
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return 0;
@@ -268,18 +451,29 @@ uint64_t store_create(const char* name, uint64_t pool_bytes,
   Store* s = new Store();
   s->base = static_cast<uint8_t*>(base);
   s->map_size = total;
+  s->client = -1;
+  s->pid = 0;
   std::snprintf(s->name, sizeof(s->name), "%s", name);
   Header* h = s->h = reinterpret_cast<Header*>(base);
   h->pool_size = total;
-  h->table_offset = header_bytes;
-  h->arena_offset = header_bytes + table_bytes;
+  h->clients_offset = header_bytes;
+  h->ledgers_offset = header_bytes + clients_bytes;
+  h->table_offset = h->ledgers_offset + ledgers_bytes;
+  h->arena_offset = h->table_offset + table_bytes;
   h->arena_size = total - h->arena_offset;
   h->max_objects = max_objects;
+  h->max_clients = kMaxClients;
+  h->ledger_cap = ledger_cap;
+  h->generation = 0;
   h->lru_clock = 1;
   h->evict_enabled = (uint32_t)evict_enabled;
   h->free_head = kNull;
   h->bytes_in_use = 0;
   h->num_objects = 0;
+  h->num_sweeps = 0;
+  h->refs_swept = 0;
+  h->partials_reclaimed = 0;
+  h->ledger_overflows = 0;
 
   pthread_mutexattr_t attr;
   pthread_mutexattr_init(&attr);
@@ -288,7 +482,8 @@ uint64_t store_create(const char* name, uint64_t pool_bytes,
   pthread_mutex_init(&h->mutex, &attr);
   pthread_mutexattr_destroy(&attr);
 
-  std::memset(s->base + h->table_offset, 0, table_bytes);
+  std::memset(s->base + h->clients_offset, 0,
+              clients_bytes + ledgers_bytes + table_bytes);
   // One big free block spanning the arena.
   blk_set(s, 0, h->arena_size, 0);
   freelist_insert(s, 0);
@@ -317,8 +512,58 @@ uint64_t store_attach(const char* name) {
   s->base = static_cast<uint8_t*>(base);
   s->h = h;
   s->map_size = (size_t)st.st_size;
+  s->client = -1;
+  s->pid = 0;
   std::snprintf(s->name, sizeof(s->name), "%s", name);
   return reinterpret_cast<uint64_t>(s);
+}
+
+// Register this process in the client registry so its refs are sweepable
+// if it dies uncleanly. Idempotent per pid (a second handle in the same
+// process shares the slot and ledger). Returns the slot, or -1 when the
+// registry is full even after draining dead clients.
+int32_t store_register(uint64_t handle, int32_t pid) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  for (int pass = 0; pass < 2; ++pass) {
+    int32_t free_slot = -1;
+    for (uint32_t i = 0; i < s->h->max_clients; ++i) {
+      ClientSlot* c = &clients(s)[i];
+      if (c->state == 1 && c->pid == pid) {
+        s->client = (int32_t)i;
+        s->pid = pid;
+        unlock(s);
+        return (int32_t)i;
+      }
+      if (c->state == 0 && free_slot < 0) free_slot = (int32_t)i;
+    }
+    if (free_slot >= 0) {
+      ClientSlot* c = &clients(s)[free_slot];
+      c->pid = pid;
+      c->state = 1;
+      c->generation = ++s->h->generation;
+      c->overflow = 0;
+      std::memset(ledger(s, (uint32_t)free_slot), 0,
+                  (uint64_t)s->h->ledger_cap * sizeof(LedgerEntry));
+      s->client = free_slot;
+      s->pid = pid;
+      unlock(s);
+      return free_slot;
+    }
+    if (pass == 0) sweep_locked(s, nullptr);  // registry full: evict the dead
+  }
+  unlock(s);
+  return -1;
+}
+
+// Drain dead clients' refs. out4 (may be NULL): [clients_swept,
+// refs_dropped, partials_reclaimed, ledger_overflows_total].
+int32_t store_sweep(uint64_t handle, uint64_t* out4) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  int32_t n = sweep_locked(s, out4);
+  unlock(s);
+  return n;
 }
 
 // Returns ABSOLUTE payload offset within the mapping (for Python's
@@ -352,6 +597,8 @@ uint64_t store_create_object(uint64_t handle, const uint8_t* id, uint64_t size,
   e->state = kStateCreated;
   e->refcount = 1;  // creator holds a ref until seal+release
   e->lru = s->h->lru_clock++;
+  e->creator = s->client >= 0 ? (uint32_t)s->client + 1 : 0;
+  ledger_adjust(s, e, 1);
   s->h->num_objects++;
   unlock(s);
   if (err) *err = 0;
@@ -365,6 +612,7 @@ int32_t store_seal(uint64_t handle, const uint8_t* id) {
   if (e && e->state == kStateDeleting) {
     // Deleted mid-write: drop the creator ref; last ref frees the block.
     if (e->refcount > 0) e->refcount--;
+    ledger_adjust(s, e, -1);
     if (e->refcount == 0) free_entry(s, e);
     unlock(s);
     return -1;
@@ -375,6 +623,7 @@ int32_t store_seal(uint64_t handle, const uint8_t* id) {
   }
   e->state = kStateSealed;
   e->refcount -= 1;
+  ledger_adjust(s, e, -1);
   unlock(s);
   return 0;
 }
@@ -390,6 +639,7 @@ int32_t store_get(uint64_t handle, const uint8_t* id, uint64_t* abs_offset,
     return -1;
   }
   e->refcount++;
+  ledger_adjust(s, e, 1);
   e->lru = s->h->lru_clock++;
   *abs_offset = s->h->arena_offset + e->offset;
   *size = e->size;
@@ -415,6 +665,7 @@ int32_t store_release(uint64_t handle, const uint8_t* id) {
     return -1;
   }
   if (e->refcount > 0) e->refcount--;
+  ledger_adjust(s, e, -1);
   if (e->refcount == 0 && e->state == kStateDeleting) free_entry(s, e);
   unlock(s);
   return 0;
@@ -480,12 +731,41 @@ void store_stats(uint64_t handle, uint64_t* out8) {
   out8[4] = s->h->bytes_evicted;
   out8[5] = s->h->pool_size;
   out8[6] = s->h->max_objects;
-  out8[7] = 0;
+  out8[7] = s->h->ledger_overflows;
+  unlock(s);
+}
+
+// Sweep stats snapshot: [num_sweeps, refs_swept, partials_reclaimed,
+// active_clients].
+void store_sweep_stats(uint64_t handle, uint64_t* out4) {
+  Store* s = reinterpret_cast<Store*>(handle);
+  lock(s);
+  out4[0] = s->h->num_sweeps;
+  out4[1] = s->h->refs_swept;
+  out4[2] = s->h->partials_reclaimed;
+  uint64_t active = 0;
+  for (uint32_t i = 0; i < s->h->max_clients; ++i) {
+    if (clients(s)[i].state == 1) active++;
+  }
+  out4[3] = active;
   unlock(s);
 }
 
 void store_detach(uint64_t handle) {
   Store* s = reinterpret_cast<Store*>(handle);
+  if (s->client >= 0) {
+    // Clean disconnect: drain this process's own ledger so held refs
+    // don't pin objects after exit. NOTE: the slot is per-pid, so all
+    // handles in one process share it — detach drains them all, which
+    // is safe because detach happens at process shutdown.
+    lock(s);
+    ClientSlot* c = &clients(s)[s->client];
+    if (c->state == 1 && c->pid == s->pid) {
+      drain_client_locked(s, (uint32_t)s->client, nullptr);
+    }
+    s->client = -1;
+    unlock(s);
+  }
   munmap(s->base, s->map_size);
   delete s;
 }

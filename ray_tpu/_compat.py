@@ -1,0 +1,55 @@
+"""Version-tolerant jax shims shared across ops/ and parallel/.
+
+The repo targets current jax but must import (and dryrun on CPU) under
+older releases where `shard_map` still lives in jax.experimental and
+takes `check_rep` instead of `check_vma`.  Centralizing the probe here
+keeps every call site on ONE spelling: ``shard_map(f, mesh=..., in_specs=...,
+out_specs=..., check_vma=False)``.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pre-0.6 jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _SHARD_MAP_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # C-level signature: trust the new API
+    _SHARD_MAP_PARAMS = frozenset({"check_vma"})
+
+
+try:
+    from jax.lax import axis_size
+except ImportError:  # pre-0.6 jax
+    def axis_size(axis_name):
+        """Static size of a manual mesh axis inside shard_map."""
+        import jax.core as _core
+
+        frame = _core.axis_frame(axis_name)
+        # Newer 0.4.x returns the size directly; older returns a frame.
+        return getattr(frame, "size", frame)
+
+
+@functools.wraps(_shard_map)
+def shard_map(f=None, /, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        flag = kwargs.pop("check_vma")
+        # Old spelling of the same replication/varying-manual-axes check.
+        if "check_rep" in _SHARD_MAP_PARAMS:
+            kwargs.setdefault("check_rep", flag)
+    if "axis_names" in kwargs and "axis_names" not in _SHARD_MAP_PARAMS:
+        # New API names the MANUAL axes; old API names the complement
+        # (`auto`).  Translate via the mesh's full axis set.
+        manual = frozenset(kwargs.pop("axis_names"))
+        mesh = kwargs.get("mesh")
+        if mesh is not None and "auto" in _SHARD_MAP_PARAMS:
+            auto = frozenset(mesh.axis_names) - manual
+            if auto:
+                kwargs.setdefault("auto", auto)
+    if f is None:  # used as a decorator factory: shard_map(mesh=...)(f)
+        return lambda g: _shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)

@@ -36,6 +36,12 @@ _LEASE_PIPELINE_MAX = 16  # max in-flight tasks per leased worker
 _LEASE_IDLE_RETURN_S = 0.5  # idle leases are given back after this
 _FLUSH_INTERVAL_S = 0.002  # safety flush for lazily-buffered sends
 
+# get() resolution kinds: direct-call reply socket, same-host store hit
+# (zero RPCs), or the GCS directory.
+_GET_DIRECT = object()
+_GET_LOCAL = object()
+_GET_GCS = object()
+
 
 class CoreClient:
     def __init__(
@@ -1311,7 +1317,23 @@ class CoreClient:
         if size <= RayConfig.max_inline_object_size:
             blob = bytearray(size)
             serialization.write_to(memoryview(blob), payload, buffers)
-            fields = {"object_id": oid.binary(), "inline": bytes(blob), "size": size}
+            blob = bytes(blob)
+            fields = {"object_id": oid.binary(), "inline": blob, "size": size}
+            # Node-segment copy for small values too (zero extra
+            # syscalls — one pool create under the shm mutex): it is
+            # what same-host readers hit with zero head round-trips,
+            # and the local bearer of truth reconcile re-advertises
+            # after a head failover — which is what makes the advert
+            # below safe to fire-and-forget.
+            try:
+                loc = self.store.try_pool_put_packed(oid, blob)
+            except Exception:  # noqa: BLE001 - pool mid-close
+                self._pool_put_errors = getattr(
+                    self, "_pool_put_errors", 0
+                ) + 1
+                loc = None
+            if loc is not None:
+                fields["segment"] = loc
         else:
             name = object_segment_put(self.store, oid, payload, buffers, size)
             fields = {"object_id": oid.binary(), "segment": name, "size": size}
@@ -1319,10 +1341,36 @@ class CoreClient:
             # Refs nested inside the stored value: the directory pins them
             # while this object lives (borrowing — reference_count.h:61).
             fields["children"] = cap.seen
+        if fields.get("segment") is not None and not cap.seen:
+            # Async advert (the put fast path): the value is sealed in
+            # the local store, so the directory learns of it via a
+            # buffered fire-and-forget frame — zero blocking round
+            # trips per put. Safe because (a) frames on one conn are
+            # FIFO, so any later submit/free naming this object lands
+            # after the advert; (b) chaos faults never target
+            # put_object; (c) on conn loss the reconnect reconcile
+            # re-advertises every owned object from store.location_of.
+            # Values with captured child refs stay synchronous: the
+            # children pins ride the advert and must survive failover.
+            self._advert_async({"type": "put_object", **fields})
+            return fields
         reply = self.request_reliable({"type": "put_object", **fields})
         if not reply.get("ok"):
             raise RayTpuError(f"put failed: {reply}")
         return fields
+
+    def _advert_async(self, msg: Dict[str, Any]) -> None:
+        rec = _events.get_recorder()
+        if rec.enabled:
+            rec.record(
+                _events.OBJECT, ObjectID(msg["object_id"]).hex(),
+                "SHM_PUT_ADVERT", {"size": msg.get("size", 0)},
+            )
+        try:
+            self.conn.send_lazy(msg)
+        except ConnectionLost:
+            return  # reconcile re-advertises from the store on reconnect
+        self._mark_lazy(self.conn)
 
     def _materialize(self, reply: Dict[str, Any], oid: ObjectID,
                      _retried: bool = False, packed: bool = False,
@@ -1529,32 +1577,49 @@ class CoreClient:
         # Pipeline: fire every get_object request up front, then collect —
         # a batch of N costs one round-trip of latency, not N (reference:
         # the core worker batches plasma fetches in Get, core_worker.cc).
+        rec = _events.get_recorder()
         futs = []
         for ref in refs:
             entry = self._direct_results.get(ref.id().binary())
             if entry is not None:
                 # Direct call result: resolves on the direct socket,
                 # no GCS round-trip.
-                futs.append((ref, entry, True))
-            else:
-                try:
-                    fut = self.conn.request_async(
-                        {"type": "get_object", "object_id": ref.id().binary()}
+                futs.append((ref, entry, _GET_DIRECT))
+                continue
+            # Local-first: a sealed same-host copy (node shm segment or
+            # fallback segment) serves the get with zero RPCs — objects
+            # are immutable once sealed, so no directory consult can
+            # change the bytes. A copy that vanishes between this check
+            # and the read (spilled/freed mid-flight) falls back to the
+            # directory inside _materialize's retry.
+            if self.store.contains(ref.id()):
+                if rec.enabled:
+                    rec.record(
+                        _events.OBJECT, ref.id().hex(), "SHM_GET_LOCAL", {}
                     )
-                except ConnectionLost:
-                    # Head mid-restart: the collection loop re-issues
-                    # this one after the failover lands.
-                    fut = None
-                futs.append((ref, fut, False))
+                futs.append((ref, {"status": "READY"}, _GET_LOCAL))
+                continue
+            try:
+                fut = self.conn.request_async(
+                    {"type": "get_object", "object_id": ref.id().binary()}
+                )
+            except ConnectionLost:
+                # Head mid-restart: the collection loop re-issues
+                # this one after the failover lands.
+                fut = None
+            futs.append((ref, fut, _GET_GCS))
         out = []
-        for ref, ent, direct in futs:
+        for ref, ent, kind in futs:
             remaining = None
+            direct = kind is _GET_DIRECT
             if deadline is not None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise GetTimeoutError(f"get timed out on {ref}")
             if direct:
                 fields = self._resolve_direct_entry(ref, ent, remaining)
+            elif kind is _GET_LOCAL:
+                fields = ent
             else:
                 fields = self._gcs_get_fields(ref, ent, deadline)
             if direct and (

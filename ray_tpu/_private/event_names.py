@@ -52,9 +52,22 @@ EVENTS_BY_CATEGORY = {
     ),
     "lease": frozenset({"GRANTED", "RETURNED"}),
     "object": frozenset(
-        {"SEALED", "SPILLED", "FREED_BATCH", "PUT_BACKPRESSURE"}
+        {
+            "SEALED", "SPILLED", "FREED_BATCH", "PUT_BACKPRESSURE",
+            # Shared-memory object plane (PR 12): fire-and-forget put
+            # advertisement, get served from the node segment with zero
+            # RPCs, and the raylet's dead-client refcount sweep.
+            "SHM_PUT_ADVERT", "SHM_GET_LOCAL", "SHM_SWEEP",
+        }
     ),
-    "transfer": frozenset({"PULL", "PULL_RETRY", "PUSH"}),
+    "transfer": frozenset(
+        {
+            "PULL", "PULL_RETRY", "PUSH",
+            # Same-host pull served by mapping the provider's node
+            # segment: one memcpy, zero data bytes over the socket.
+            "SHM_PULL",
+        }
+    ),
     "sched": frozenset({"BLOCKED"}),
     "refs": frozenset(
         {

@@ -1467,7 +1467,11 @@ class GcsServer:
                 entry.children.append(child)
                 self.objects.setdefault(child, ObjectEntry()).child_pins += 1
             self._notify_object(entry)
-        state["peer"].reply(msg, ok=True)
+        # Fire-and-forget adverts (the shm put fast path: the value is
+        # already sealed in the putter's node segment) carry no req_id;
+        # only the synchronous path gets an ack.
+        if "req_id" in msg:
+            state["peer"].reply(msg, ok=True)
 
     def _object_reply_fields(self, entry: ObjectEntry) -> Dict[str, Any]:
         if entry.status == FAILED:

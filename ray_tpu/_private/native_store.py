@@ -27,18 +27,27 @@ _lib_lock = threading.Lock()
 
 def _build() -> bool:
     os.makedirs(_NATIVE_DIR, exist_ok=True)
+    # Build to a private name, then atomically publish (same pattern as
+    # fastpath._build): a concurrent builder in another cluster process
+    # must never dlopen a half-written .so.
+    tmp = f"{_LIB_PATH}.tmp.{os.getpid()}"
     try:
         subprocess.run(
             [
                 "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-Wall",
-                "-o", _LIB_PATH, _SRC, "-lpthread", "-lrt",
+                "-o", tmp, _SRC, "-lpthread", "-lrt",
             ],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _LIB_PATH)
         return True
     except Exception:  # noqa: BLE001 - no toolchain → fallback store
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -79,7 +88,16 @@ def get_lib():
         lib.store_release.argtypes = [ctypes.c_uint64, ctypes.c_char_p]
         lib.store_delete.restype = ctypes.c_int32
         lib.store_delete.argtypes = [ctypes.c_uint64, ctypes.c_char_p]
+        lib.store_register.restype = ctypes.c_int32
+        lib.store_register.argtypes = [ctypes.c_uint64, ctypes.c_int32]
+        lib.store_sweep.restype = ctypes.c_int32
+        lib.store_sweep.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)
+        ]
         lib.store_stats.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        lib.store_sweep_stats.argtypes = [
             ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)
         ]
         lib.store_detach.argtypes = [ctypes.c_uint64]
@@ -133,6 +151,11 @@ class PoolStore:
                 f"store_{'create' if create else 'attach'}({name}) failed"
             )
         self._owner = create
+        # Register in the pool's client registry so this process's refs
+        # are sweepable if it dies uncleanly (SIGKILL). -1 (registry
+        # full) degrades to unregistered: refcounts still correct while
+        # alive, just not crash-sweepable.
+        self.client_slot = lib.store_register(self._h, os.getpid())
         # Map the pool in Python for zero-copy payload access.
         from multiprocessing import resource_tracker, shared_memory
 
@@ -198,6 +221,34 @@ class PoolStore:
             "bytes_evicted": out[4],
             "pool_size": out[5],
             "max_objects": out[6],
+            "ledger_overflows": out[7],
+        }
+
+    def sweep(self) -> dict:
+        """Drop dead clients' refs (disconnect sweep). Reclaims a
+        SIGKILLed creator's unsealed partials — they never seal — and
+        completes deferred deletes its refs were pinning."""
+        if not self._h:
+            raise RuntimeError("store closed")
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.store_sweep(self._h, out)
+        return {
+            "clients_swept": out[0],
+            "refs_dropped": out[1],
+            "partials_reclaimed": out[2],
+            "ledger_overflows": out[3],
+        }
+
+    def sweep_stats(self) -> dict:
+        if not self._h:
+            raise RuntimeError("store closed")
+        out = (ctypes.c_uint64 * 4)()
+        self._lib.store_sweep_stats(self._h, out)
+        return {
+            "num_sweeps": out[0],
+            "refs_swept": out[1],
+            "partials_reclaimed": out[2],
+            "active_clients": out[3],
         }
 
     def close(self) -> None:

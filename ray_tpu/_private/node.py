@@ -93,6 +93,36 @@ class Node:
                 os.environ["RAY_TPU_POOL_NAME"] = pool_name
         except Exception:  # noqa: BLE001 - per-object segments fallback
             self._pool = None
+        # Dead-client ledger sweep on the head segment: SIGKILLed
+        # workers can't drain their refcounts, so the segment owner
+        # reclaims them on the health-check cadence (the raylet does
+        # the same for remote-node segments in its heartbeat loop).
+        self._pool_sweep_stop = None
+        if self._pool is not None:
+            import threading
+
+            from . import events as _events
+            from .config import RayConfig
+
+            stop = threading.Event()
+            interval = RayConfig.health_check_period_ms / 1000.0
+
+            def _sweep_loop(pool=self._pool):
+                while not stop.wait(interval):
+                    try:
+                        swept = pool.sweep()
+                    except Exception:  # noqa: BLE001 - destroyed segment
+                        stop.set()  # shutdown race: end the loop
+                        return
+                    if swept.get("clients_swept") and _events.enabled():
+                        _events.record(
+                            _events.OBJECT, "head", "SHM_SWEEP", swept
+                        )
+
+            self._pool_sweep_stop = stop
+            threading.Thread(
+                target=_sweep_loop, name="pool-sweep", daemon=True
+            ).start()
         self._transfer = None
         head_transfer_addr = ""
         if tcp_port is not None:
@@ -135,6 +165,9 @@ class Node:
         if self._transfer is not None:
             self._transfer.shutdown()
             self._transfer = None
+        if self._pool_sweep_stop is not None:
+            self._pool_sweep_stop.set()
+            self._pool_sweep_stop = None
         if self._pool is not None:
             try:
                 self._pool.destroy()

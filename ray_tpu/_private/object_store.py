@@ -222,6 +222,16 @@ class ObjectStore:
         view = self._pool.create(key, max(size, 1))
         if view is not None or self._pool.contains(key):
             return view
+        # Full pool: before blocking, reclaim refcounts (and partials)
+        # left by SIGKILLed clients — a dead reader may be the only
+        # thing pinning evictable space.
+        try:
+            if self._pool.sweep().get("clients_swept"):
+                view = self._pool.create(key, max(size, 1))
+                if view is not None or self._pool.contains(key):
+                    return view
+        except Exception:  # noqa: BLE001 - store mid-close
+            self._sweep_errors = getattr(self, "_sweep_errors", 0) + 1
         try:
             st = self._pool.stats()
             cap = st.get("pool_size") or st.get("arena_size") or 0
@@ -291,6 +301,51 @@ class ObjectStore:
                 {"size": size, "loc": "segment"},
             )
         return name
+
+    @property
+    def has_pool(self) -> bool:
+        """True when this process is attached to the node's shm pool."""
+        return self._pool is not None
+
+    def shm_source(self, object_id: ObjectID):
+        """(pool_name, size) when the sealed object lives in the node
+        pool — the name another process on this host maps to read the
+        payload without a socket. None for segment/spilled holders
+        (rare: pool-full fallbacks), which serve chunked TCP instead."""
+        if self._pool is None:
+            return None
+        key = object_id.binary()
+        try:
+            view = self._pool.get(key)
+            if view is None:
+                return None
+            size = len(view)
+            del view
+            self._pool.release(key)
+        except Exception:  # noqa: BLE001 - pool mid-close
+            self._sweep_errors = getattr(self, "_sweep_errors", 0) + 1
+            return None
+        return (self._pool.name, size)
+
+    def try_pool_put_packed(self, object_id: ObjectID, blob) -> Optional[str]:
+        """Best-effort pool write of already-flat serialized bytes: no
+        backpressure, no segment fallback. Used for small puts whose
+        advert would otherwise inline-only through the head — the pool
+        copy is the local bearer of truth a head failover reconciles
+        from, and what same-host readers hit with zero RPCs. Returns
+        "pool" or None (caller keeps the inline-only path)."""
+        if self._pool is None:
+            return None
+        key = object_id.binary()
+        view = self._pool.create(key, max(len(blob), 1))
+        if view is None:
+            # Duplicate put of the same id: already sealed with these
+            # bytes (ids are unique per value). Full pool: None.
+            return "pool" if self._pool.contains(key) else None
+        view[: len(blob)] = blob
+        del view
+        self._pool.seal(key)
+        return "pool"
 
     def put_packed(self, object_id: ObjectID, blob) -> str:
         """Write already-flat serialized bytes (the wire/store format)

@@ -29,6 +29,22 @@ CHUNK_BYTES = 4 << 20  # reference: object_manager_default_chunk_size (5 MiB)
 ATTEMPT_TIMEOUT_S = 10.0
 
 
+def _host_id() -> str:
+    """Identity of this physical host, stable across processes.
+
+    boot_id distinguishes machines sharing an IP namespace; two
+    containers on one kernel share it but not /dev/shm, which is fine —
+    the shm attach just fails and the pull falls back to chunked TCP.
+    """
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()
+    except OSError:
+        import socket
+
+        return socket.gethostname()
+
+
 class ObjectTransferServer:
     """Serves raw object bytes from the node-local store.
 
@@ -79,6 +95,19 @@ class ObjectTransferServer:
             peer.start()
 
     def _handle(self, peer: PeerConn, msg):
+        if msg.get("type") == "shm_locate":
+            # Same-host shortcut handshake: name the node segment that
+            # holds the object so a consumer on THIS host can map it and
+            # copy once — zero bytes over the socket. A consumer on
+            # another host sees the host-id mismatch and pulls chunks.
+            src = self._store.shm_source(ObjectID(msg["object_id"]))
+            if src is None:
+                peer.reply(msg, ok=False, error="no shm source",
+                           host=_host_id())
+            else:
+                peer.reply(msg, ok=True, host=_host_id(),
+                           pool=src[0], size=src[1])
+            return
         if msg.get("type") != "pull_chunk":
             if "req_id" in msg:
                 peer.reply(msg, ok=False, error="unknown message")
@@ -167,6 +196,14 @@ class ObjectFetcher:
         self._conns: Dict[str, PeerConn] = {}
         self._lock = threading.Lock()
         self._inflight: Dict[bytes, threading.Event] = {}
+        # Same-host shortcut state: provider address -> its host id
+        # (learned on the first shm_locate; remote hosts are never asked
+        # again), and provider pool name -> our read-only attachment.
+        self._peer_hosts: Dict[str, str] = {}
+        self._peer_pools: Dict[str, object] = {}
+        # Counted-never-silent shortcut faults (attach/copy/teardown
+        # races degrade to the TCP pull, but the count must exist).
+        self._shm_pull_failed = 0
 
     def _conn_for(self, address: str) -> PeerConn:
         with self._lock:
@@ -225,6 +262,11 @@ class ObjectFetcher:
                 if remaining <= 0:
                     break
                 try:
+                    ok, size = self._try_shm_pull(
+                        oid, address, min(remaining, ATTEMPT_TIMEOUT_S)
+                    )
+                    if ok:
+                        break
                     ok, size, transient = self._pull_chunks(
                         oid, address, min(remaining, ATTEMPT_TIMEOUT_S)
                     )
@@ -259,6 +301,81 @@ class ObjectFetcher:
             with self._lock:
                 self._inflight.pop(key, None)
             ev.set()
+
+    def _try_shm_pull(self, oid: ObjectID, address: str, timeout) -> Tuple[bool, int]:
+        """Same-host pull through the provider's node segment: map its
+        pool by name and copy the payload once — zero socket bytes for
+        the data plane, so an n-worker same-host broadcast is one copy
+        per node instead of n socket round-trips of the full payload.
+        Returns (pulled, size); any miss (remote host, pool-less
+        provider, attach failure, raced eviction) falls back to the
+        chunked TCP pull. Never raises."""
+        import concurrent.futures
+
+        known = self._peer_hosts.get(address)
+        me = _host_id()
+        if known is not None and known != me:
+            return False, 0  # provider is on another machine: TCP
+        try:
+            peer = self._conn_for(address)
+            reply = peer.request(
+                {"type": "shm_locate", "object_id": oid.binary()},
+                timeout=timeout,
+            )
+        except (ConnectionLost, OSError, TimeoutError,
+                concurrent.futures.TimeoutError):
+            return False, 0
+        host = reply.get("host")
+        if host:
+            self._peer_hosts[address] = host
+        if host != me or not reply.get("ok"):
+            return False, 0
+        pool_name, size = reply["pool"], reply["size"]
+        key = oid.binary()
+        try:
+            pool = self._peer_pools.get(pool_name)
+            if pool is None:
+                from .native_store import PoolStore, native_available
+
+                if not native_available():
+                    return False, 0
+                pool = PoolStore(pool_name, create=False)
+                self._peer_pools[pool_name] = pool
+            src = pool.get(key)  # pins against provider-side delete
+        except Exception:  # noqa: BLE001 - foreign /dev/shm namespace
+            self._shm_pull_failed += 1
+            self._peer_pools.pop(pool_name, None)
+            self._peer_hosts[address] = f"!{host}"  # never retry attach
+            return False, 0
+        if src is None:
+            return False, 0  # raced eviction/spill: TCP path re-resolves
+        try:
+            view = self._store.create_raw(oid, size)
+            if view is None:
+                return self._store.contains(oid), size
+            try:
+                view[:size] = src[:size]
+                del view
+            except Exception:  # noqa: BLE001 - reclaim the partial
+                self._shm_pull_failed += 1
+                del view
+                self._store.abort_raw(oid)
+                return False, 0
+            self._store.seal_raw(oid)
+        finally:
+            del src
+            try:
+                pool.release(key)
+            except Exception:  # noqa: BLE001 - pool torn down mid-copy
+                self._shm_pull_failed += 1
+                self._peer_pools.pop(pool_name, None)
+        rec = _events.get_recorder()
+        if rec.enabled:
+            rec.record(
+                _events.TRANSFER, oid.hex(), "SHM_PULL",
+                {"from": address, "bytes": size, "pool": pool_name},
+            )
+        return True, size
 
     def _pull_chunks(
         self, oid: ObjectID, address: str, timeout
@@ -319,5 +436,12 @@ class ObjectFetcher:
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
+            pools = list(self._peer_pools.values())
+            self._peer_pools.clear()
         for c in conns:
             c.close()
+        for p in pools:
+            try:
+                p.close()  # detach only — the provider owns the segment
+            except Exception:  # noqa: BLE001 - already destroyed
+                self._shm_pull_failed += 1

@@ -258,6 +258,46 @@ def bench_objects():
         f"{RESULTS['single_client_put_gigabytes']} GiB/s"
     )
 
+    # Loopback broadcast: one put, N same-host workers each materialize
+    # the full payload through the node segment (mmap + refcount — the
+    # plasma contract). Workers are warmed first so the row measures
+    # the data plane, not fork+import. The honest yardstick is the
+    # host_memcpy calibration: a copy-per-consumer design caps at
+    # memcpy/N; the shared segment should stay within ~2x of memcpy.
+    n_consumers = 4
+    payload = np.zeros(128 << 20, dtype=np.uint8)  # 128 MiB
+
+    @ray_tpu.remote(num_cpus=0)
+    def _bcast_read(ref):
+        return len(ray_tpu.get(ref[0]))
+
+    ray_tpu.get([_bcast_read.remote([ray_tpu.put(b"warm")])
+                 for _ in range(n_consumers)])  # spawn + import done
+    bref = ray_tpu.put(payload)
+    best_dt = float("inf")
+    for trial in range(3):
+        t0 = time.perf_counter()
+        sizes = ray_tpu.get(
+            [_bcast_read.remote([bref]) for _ in range(n_consumers)],
+            timeout=900,
+        )
+        dt = time.perf_counter() - t0
+        assert all(s == len(payload) for s in sizes)
+        # Trial 0 pays each worker's first map of the segment pages;
+        # steady state (best-of) is the data-plane number, matching the
+        # warm-loop methodology of the other rows.
+        best_dt = min(best_dt, dt)
+    RESULTS["loopback_broadcast_gigabytes"] = round(
+        n_consumers * len(payload) / best_dt / (1 << 30), 2
+    )
+    print(
+        f"loopback_broadcast_gigabytes: "
+        f"{RESULTS['loopback_broadcast_gigabytes']} GiB/s "
+        f"({n_consumers} consumers x {len(payload) >> 20} MiB)"
+    )
+    ray_tpu.free([bref])
+    del payload
+
     # Match the reference's semantics exactly (ray_perf.py
     # wait_multiple_refs): submit 1000 LIVE tasks, then drain them with
     # successive wait(num_returns=1) calls as results arrive — this

@@ -503,6 +503,30 @@ class NodeDaemon:
 
     # ------------------------------------------------------------ lifecycle
 
+    def _sweep_pool_clients(self):
+        """Reclaim segment refcounts held by dead clients.
+
+        A SIGKILLed worker can't drain its per-client ledger, so the
+        raylet (segment owner) sweeps on its heartbeat cadence: each
+        registered pid is liveness-probed (kill(pid, 0)) and a dead
+        client's ledger is subtracted from the global refcounts, with
+        its unsealed partials freed — never sealed.  Runs under the
+        segment's robust mutex in C; any thread may call it.
+        """
+        if self._pool is None:
+            return
+        try:
+            swept = self._pool.sweep()
+        except Exception:  # noqa: BLE001 - segment destroyed mid-shutdown
+            self._pool_sweep_errors = getattr(
+                self, "_pool_sweep_errors", 0
+            ) + 1
+            return
+        if swept.get("clients_swept") and _events.enabled():
+            _events.record(
+                _events.OBJECT, self.node_id, "SHM_SWEEP", swept
+            )
+
     def _heartbeat_loop(self):
         interval = RayConfig.health_check_period_ms / 1000.0
         while not self._shutdown.wait(interval):
@@ -510,6 +534,7 @@ class NodeDaemon:
             # sees silence and must declare the node dead on its own
             # timer (gcs health loop), never on a clean disconnect.
             _chaos.kill_point("raylet.heartbeat")
+            self._sweep_pool_clients()
             try:
                 msg = {
                     "type": "node_heartbeat",

@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ray_tpu._compat import axis_size, shard_map
 
 from .attention import _flash_bwd_pallas, _flash_fwd_pallas, _on_tpu
 
@@ -119,7 +119,7 @@ def _ring(q, k, v, axis_name, causal, scale):
 def _ring_fwd(q, k, v, axis_name, causal, scale):
     b, h, t, d = q.shape
     bh = b * h
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     qf = q.reshape(bh, t, d)
@@ -160,7 +160,7 @@ def _ring_bwd_rule(axis_name, causal, scale, res, do):
     q, k, v, o, lse = res
     b, h, t, d = q.shape
     bh = b * h
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     qf = q.reshape(bh, t, d)

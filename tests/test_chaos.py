@@ -8,6 +8,7 @@ the shared Backoff/retry and in-order ref_flush sequencing the
 hardened failure paths ride.
 """
 import gc
+import os
 import random
 import time
 
@@ -386,7 +387,10 @@ def test_dead_borrower_late_add_ignored():
 
 def test_chaos_delay_rule_via_system_config():
     """The chaos engine subsumes testing_rpc_delay_us: a delay rule on
-    put_object visibly stretches the put round-trip."""
+    put_object visibly stretches the put round-trip. Pool disabled:
+    the shm segment's put advert is async by design and never blocks,
+    so the rule is only observable on the legacy synchronous path."""
+    os.environ["RAY_TPU_NATIVE_STORE"] = "0"
     ray_tpu.init(
         num_cpus=2,
         _system_config={
@@ -403,6 +407,7 @@ def test_chaos_delay_rule_via_system_config():
     finally:
         ray_tpu.shutdown()
         chaos.install("", 0)
+        os.environ.pop("RAY_TPU_NATIVE_STORE", None)
 
 
 def test_dropped_ref_flush_batches_still_release(monkeypatch):

@@ -193,6 +193,10 @@ def test_actors_survive_live_head_failover(tmp_path):
 
 def test_rpc_delay_injection():
     # Reference: RAY_testing_asio_delay_us (ray_config_def.h:832).
+    # Pool disabled: a same-host put through the shm segment advertises
+    # asynchronously and never blocks on put_object, so the delay rule
+    # is only observable on the legacy synchronous path.
+    os.environ["RAY_TPU_NATIVE_STORE"] = "0"
     ray_tpu.init(
         num_cpus=2,
         _system_config={"testing_rpc_delay_us": "put_object=30000:30000"},
@@ -203,3 +207,4 @@ def test_rpc_delay_injection():
         assert time.monotonic() - start >= 0.03
     finally:
         ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_NATIVE_STORE", None)
