@@ -4,10 +4,10 @@
 
 .PHONY: ci native lint raylint raylint-baseline race-smoke test \
 	obs-smoke envelope-smoke chaos-smoke failover-smoke \
-	pressure-smoke shm-smoke stress clean
+	pressure-smoke shm-smoke partition-smoke stress clean
 
 ci: native lint test obs-smoke envelope-smoke chaos-smoke failover-smoke \
-	pressure-smoke race-smoke shm-smoke
+	pressure-smoke race-smoke shm-smoke partition-smoke
 
 native:
 	$(MAKE) -C native
@@ -108,6 +108,26 @@ failover-smoke:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
 		--only head_failover --failover-smoke \
 		--out /tmp/ray_tpu_failover_smoke.json
+
+# Partition soak, short + seeded (1 victim daemon fully partitioned
+# from the head past the death threshold while holding a restartable
+# actor, leased tasks and owned objects; scheduled heal; then one
+# supervised-head SIGKILL to prove fencing composes with failover).
+# Asserts zero wedged gets, at-most-once actor side effects across the
+# false death (per-incarnation boot tokens never interleave, counters
+# stay monotonic), no resurrected freed objects, NODE_FENCED +
+# ZOMBIE_SELF_FENCE visible, and the victim back as a NEW node id with
+# a HIGHER incarnation. A red run reproduces with
+#   python -m ray_tpu._private.ray_perf --only partition_soak \
+#       --partition-smoke --chaos-seed <printed seed>
+# A host that cannot launch the external head records an explicit
+# partition_soak_skipped row — counted, never silent. The full
+# two-node soak:
+#   python -m ray_tpu._private.ray_perf --only partition_soak
+partition-smoke:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
+		--only partition_soak --partition-smoke \
+		--out /tmp/ray_tpu_partition_smoke.json
 
 # Memory-pressure soak, scaled down (a 32 MiB broadcast chunk train to
 # 8 real daemon nodes concurrent with hundreds of small gets, under a

@@ -42,6 +42,22 @@ comma-separated entries):
         process, the hook site injects the named failure (EIO, ENOSPC,
         a truncated file) and the degradation ladder must absorb it.
 
+    partition:<roleA><-><roleB>=<start_s>[:<heal_after_s>][?dir=both|a2b|b2a]
+        Sustained link cut between two process roles: every PeerConn
+        frame flowing a blocked direction is blackholed (the TCP
+        connection stays ESTABLISHED — the gray failure a heartbeat
+        sweeper must catch) from ``start_s`` until
+        ``start_s + heal_after_s`` (no heal term = cut forever).
+        ``dir=a2b`` cuts only roleA→roleB traffic (asymmetric
+        partition); ``b2a`` the reverse; default both. Windows are
+        measured from a shared epoch (env ``RAY_TPU_chaos_epoch``,
+        else this schedule's install time) so every process in the
+        fleet agrees on when the cut begins and heals. Because the
+        sender's AND the receiver's schedule both enforce the cut,
+        installing the spec in only one side's processes still cuts
+        both directions of its links. Transitions record
+        PARTITION_BEGIN / PARTITION_HEAL chaos events.
+
 Determinism: every rule draws from its own ``random.Random`` seeded by
 sha256(seed, rule-text) — the nth decision of a rule is a pure function
 of (seed, rule, n), so a failed run replays with one env var
@@ -70,6 +86,7 @@ __all__ = [
     "active",
     "kill_point",
     "fault_point",
+    "partition_blocks",
     "mtype_of",
 ]
 
@@ -271,6 +288,34 @@ class _KillRule:
         self.fired = 0
 
 
+class _PartitionRule:
+    __slots__ = (
+        "role_a", "role_b", "start_s", "heal_s", "direction", "key",
+        "began", "healed",
+    )
+
+    def __init__(self, role_a, role_b, start_s, heal_s, direction, key):
+        self.role_a = role_a
+        self.role_b = role_b
+        self.start_s = start_s
+        # Absolute offset from the epoch at which the link heals
+        # (None = never).
+        self.heal_s = heal_s
+        self.direction = direction  # both | a2b | b2a
+        self.key = key
+        self.began = False
+        self.healed = False
+
+    def covers(self, src: str, dst: str) -> bool:
+        if self.direction in ("both", "a2b") and (
+            src == self.role_a and dst == self.role_b
+        ):
+            return True
+        return self.direction in ("both", "b2a") and (
+            src == self.role_b and dst == self.role_a
+        )
+
+
 def current_role() -> str:
     """Coarse process role for rule scoping. Workers carry
     RAY_TPU_WORKER_ID from spawn; raylets set RAY_TPU_CHAOS_ROLE."""
@@ -301,6 +346,17 @@ class FaultSchedule:
         # kill rules, but the hook site injects a failure instead of
         # dying (_KillRule is reused as the decision record).
         self._fault_rules: Dict[str, List[_KillRule]] = {}
+        self._partition_rules: List[_PartitionRule] = []
+        # Shared time base for partition windows: every process in the
+        # fleet must agree on when a cut begins/heals, so the epoch
+        # rides the environment (the soak exports it before spawning
+        # anything); a process without it anchors at install time.
+        try:
+            self._epoch = float(os.environ.get("RAY_TPU_chaos_epoch", ""))
+        except ValueError:
+            self._epoch = 0.0
+        if not self._epoch:
+            self._epoch = time.time()
         self.stats: Dict[str, int] = {}
         self._role = current_role()
         for i, entry in enumerate(e for e in spec.split(",") if e.strip()):
@@ -323,6 +379,13 @@ class FaultSchedule:
 
     def _parse_entry(self, entry: str, index: int) -> None:
         role = None
+        direction = "both"
+        if "?dir=" in entry:
+            entry, direction = entry.split("?dir=", 1)
+            if direction not in ("both", "a2b", "b2a"):
+                raise ValueError(
+                    f"unknown partition direction {direction!r}"
+                )
         if "?role=" in entry:
             entry, role = entry.split("?role=", 1)
         name, _, value = entry.partition("=")
@@ -330,6 +393,25 @@ class FaultSchedule:
             raise ValueError(f"chaos_spec entry missing '=': {entry!r}")
         key = f"{index}:{entry}"
         rng = _derive_rng(self.seed, key)
+        if name.startswith("partition:"):
+            pair = name[len("partition:"):]
+            if "<->" not in pair:
+                raise ValueError(
+                    f"partition rule needs '<roleA><-><roleB>': {entry!r}"
+                )
+            role_a, role_b = pair.split("<->", 1)
+            parts = value.split(":")
+            start_s = float(parts[0])
+            heal_s = (
+                start_s + float(parts[1]) if len(parts) > 1 else None
+            )
+            self._partition_rules.append(
+                _PartitionRule(
+                    role_a.strip(), role_b.strip(), start_s, heal_s,
+                    direction, key,
+                )
+            )
+            return
         if name.startswith("kill:"):
             point = name[len("kill:"):]
             if value.startswith("p:"):
@@ -512,6 +594,55 @@ class FaultSchedule:
             )
         return True
 
+    # ------------------------------------------------------------- partitions
+
+    def partition_blocks(self, src_role: str, dst_role: str) -> bool:
+        """True when a partition rule currently cuts traffic flowing
+        ``src_role`` → ``dst_role``. Deterministic by construction:
+        windows are pure functions of the shared epoch, not of a
+        per-message RNG draw. Transition edges (first blocked message,
+        first message after heal) record one CHAOS event each."""
+        if not self._partition_rules:
+            return False
+        now = time.time() - self._epoch
+        blocked = False
+        for rule in self._partition_rules:
+            if not rule.covers(src_role, dst_role):
+                continue
+            if now < rule.start_s:
+                continue
+            if rule.heal_s is not None and now >= rule.heal_s:
+                with self._lock:
+                    heal_edge = rule.began and not rule.healed
+                    rule.healed = True
+                if heal_edge:
+                    self.stats[f"partition_heal:{rule.key}"] = 1
+                    if _events.enabled():
+                        _events.record(
+                            _events.CHAOS,
+                            f"{rule.role_a}<->{rule.role_b}",
+                            "PARTITION_HEAL",
+                            {"rule": rule.key, "at_s": round(now, 3)},
+                        )
+                continue
+            with self._lock:
+                begin_edge = not rule.began
+                rule.began = True
+                k = f"partition:{rule.key}"
+                self.stats[k] = self.stats.get(k, 0) + 1
+            if begin_edge and _events.enabled():
+                _events.record(
+                    _events.CHAOS,
+                    f"{rule.role_a}<->{rule.role_b}",
+                    "PARTITION_BEGIN",
+                    {
+                        "rule": rule.key, "dir": rule.direction,
+                        "at_s": round(now, 3),
+                    },
+                )
+            blocked = True
+        return blocked
+
     # ----------------------------------------------------------- connect hook
 
     def on_connect(self, address: str) -> None:
@@ -587,6 +718,14 @@ def fault_point(name: str) -> bool:
     truncate:spill_file."""
     sched = _active
     return sched is not None and sched.maybe_fault(name)
+
+
+def partition_blocks(src_role: str, dst_role: str) -> bool:
+    """Transport hook: True when the installed schedule currently cuts
+    ``src_role`` → ``dst_role`` traffic (one module-global read when
+    chaos is off)."""
+    sched = _active
+    return sched is not None and sched.partition_blocks(src_role, dst_role)
 
 
 def mtype_of(msg: Any) -> Optional[str]:

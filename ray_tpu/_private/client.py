@@ -126,6 +126,9 @@ class CoreClient:
             on_close=lambda gen=0: self._on_head_conn_close(gen),
             name=f"client-{role}",
         )
+        # Partition-chaos role stamp: the far side of this conn is the
+        # head (link-cut rules are expressed between named roles).
+        self.conn.peer_role = "head"
         reply = self.conn.request(
             self._hello_msg(), timeout=RayConfig.worker_register_timeout_s
         )
@@ -358,6 +361,7 @@ class CoreClient:
             conn = PeerConn(
                 raw, push_handler=self._on_push, name=f"client-{self.role}"
             )
+            conn.peer_role = "head"
             try:
                 reply = conn.request(
                     self._hello_msg(reconnect=True),
@@ -368,6 +372,14 @@ class CoreClient:
                 concurrent.futures.TimeoutError, OSError,
             ):
                 reply = None
+            if reply is not None and reply.get("fenced"):
+                # The head fenced this identity (declared-dead worker
+                # whose W_DEAD record outlived the partition): replaying
+                # the same hello can never succeed — give up now so the
+                # process exits instead of burning the whole budget.
+                conn.close()
+                reply, conn = None, None
+                break
             if reply is None or not reply.get("ok"):
                 conn.close()
                 reply, conn = None, None
@@ -529,6 +541,26 @@ class CoreClient:
             ack = self.done_ack
             if ack is not None:
                 ack(msg.get("seq", 0))
+            return
+        if mtype == "fenced":
+            # Membership fence: the head declared this client dead while
+            # a partition hid its heartbeats. A fenced worker's results
+            # and refcount edges are already being dropped head-side —
+            # the only correct move is to stop being this identity.
+            # Workers exit (the raylet's fresh incarnation respawns
+            # capacity); a driver surfaces permanent head loss.
+            if self.role == "worker":
+                self._reconnect_enabled = False
+                self.head_permanently_lost.set()
+                with self._wait_cond:
+                    self._head_conn_lost = True
+                    self._wait_cond.notify_all()
+                try:
+                    self.conn.close()
+                except Exception:  # noqa: BLE001 - counted, never silent
+                    self._fence_close_errors = getattr(
+                        self, "_fence_close_errors", 0
+                    ) + 1
             return
         self._push_handler(msg)
 

@@ -80,6 +80,9 @@ EVENTS_BY_CATEGORY = {
         {
             # Injected faults + the lock-order witness's finding.
             "FAULT", "KILLED", "NODE_KILL", "LOCK_ORDER",
+            # Partition primitive: link-cut window edges (begin on the
+            # first blocked frame, heal on the first frame after).
+            "PARTITION_BEGIN", "PARTITION_HEAL",
         }
     ),
     "head": frozenset(
@@ -87,6 +90,11 @@ EVENTS_BY_CATEGORY = {
             "HEAD_DOWN", "HEAD_RECONNECT", "RECONCILE_BEGIN",
             "RECONCILE_CLAIM", "RECONCILE_END", "GHOSTS_LOST",
             "RESUBMITS_DROPPED",
+            # Membership fencing (incarnation/epoch protocol): a stale
+            # node/client message rejected, a stale actor-epoch result
+            # rejected, and a zombie raylet draining itself after
+            # learning it was declared dead.
+            "NODE_FENCED", "ACTOR_EPOCH_FENCED", "ZOMBIE_SELF_FENCE",
         }
     ),
 }

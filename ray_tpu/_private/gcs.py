@@ -163,6 +163,11 @@ class ActorState:
     death_reason: str = ""
     # Parked get_actor_direct lookups, answered on ALIVE/DEAD transition.
     direct_waiters: List[Tuple[PeerConn, int]] = field(default_factory=list)
+    # Incarnation fence: bumped on every restart (worker death, head
+    # failover sweep). Dispatched method specs and their done records
+    # carry the epoch, so a falsely-dead incarnation's late results can
+    # never seal — at-most-once is preserved across false death.
+    epoch: int = 1
 
 
 @dataclass
@@ -183,7 +188,15 @@ class NodeState:
     # (reference: raylet NodeManager + embedded ObjectManager).
     conn: Optional[PeerConn] = None
     transfer_addr: str = ""
+    # Liveness bookkeeping rides time.monotonic() (NOT wall clock): a
+    # wall step — NTP slew, VM resume — must never mass-declare live
+    # nodes dead (the health sweep compares against monotonic now).
     last_heartbeat: float = 0.0
+    # Membership fence: granted by the head at registration, bumped
+    # when the death sweeper declares the node dead. Heartbeats carry
+    # it; a stale incarnation gets a FENCED push instead of being
+    # applied.
+    incarnation: int = 0
     # Remote drivers register as zero-resource nodes (their store serves
     # pulls) but never receive dispatched work.
     schedulable: bool = True
@@ -346,6 +359,17 @@ class GcsServer:
         # keeps them visible to the state API (reference:
         # maximum_gcs_dead_node_cached_count, gcs_node_manager.cc).
         self.dead_nodes: deque = deque(maxlen=1000)
+        # Incarnation grants are unique per head lifetime (one global
+        # monotonic counter): a node_id that dies, purges, and tries to
+        # re-register can never mint a number equal to a live one.
+        self._incarnation_seq = 0
+        # node_ids the death sweeper fenced: a register_node carrying
+        # one is a zombie and gets FENCED — it must rejoin through the
+        # normal join path with a fresh node_id (bounded with the ring).
+        self._fenced_node_ids: Set[bytes] = set()
+        # Clients already told they are fenced (one push per zombie:
+        # every dropped message repeating it would spam a healed link).
+        self._fence_pushed: Set[bytes] = set()
         self.placement_groups: Dict[bytes, PlacementGroupState] = {}
         self._pending = _PendingQueue()
         # Per-task state transitions for the state API, `ray_tpu
@@ -731,6 +755,7 @@ class GcsServer:
         peer: PeerConn = state["peer"]
         role = msg["role"]
         state["role"] = role
+        peer.peer_role = role
         node_id = self.head_node.node_id.binary()
         reply_extra: Dict[str, Any] = {}
         if role == "worker":
@@ -738,6 +763,16 @@ class GcsServer:
             state["worker_id"] = wid
             with self._lock:
                 w = self.workers.get(wid)
+                if w is not None and w.state == W_DEAD:
+                    # Membership fence: this worker was declared dead
+                    # (its node timed out, OOM kill, crash sweep). A
+                    # zombie re-hello must NOT resurrect the handle —
+                    # its actor may already be restarting elsewhere
+                    # under a new epoch. The process exits on the
+                    # fenced reply.
+                    self._record_fence("worker", wid, "dead worker hello")
+                    peer.reply(msg, ok=False, fenced=True)
+                    return
                 if w is None:
                     # Raylet-local or externally started worker: bind to
                     # its declared node (object locations must resolve
@@ -1030,7 +1065,13 @@ class GcsServer:
         w = self.workers[actor.worker_id.binary()]
         w.inflight[spec.task_id.binary()] = spec
         try:
-            w.conn.send({"type": "execute_task", "spec": spec})
+            # The epoch rides the dispatch and comes back on the done
+            # record: results from a superseded incarnation of this
+            # actor (false death → restart) can then never seal.
+            w.conn.send({
+                "type": "execute_task", "spec": spec,
+                "actor_epoch": actor.epoch,
+            })
             self._record_task_event(
                 spec.task_id.binary(), spec.name, "RUNNING",
                 actor.worker_id.binary(),
@@ -1227,6 +1268,15 @@ class GcsServer:
         error_blob = msg.get("error")
         w = self.workers.get(wid)
         task_id = msg["task_id"]
+        if w is not None and w.state == W_DEAD:
+            # Membership fence: this worker was declared dead (node
+            # heartbeat timeout, OOM, crash sweep) — its in-flight work
+            # was already failed or requeued, and its results must NOT
+            # seal now: the retry may be running (or finished) under
+            # the live incarnation, and a zombie's late seal would
+            # resurrect freed/LOST entries.
+            self._fence_dead_client(wid, "task_done from fenced worker")
+            return
         spec: Optional[TaskSpec] = w.inflight.pop(task_id, None) if w else None
         if self._recover_inflight:
             # A completion IS the strongest re-claim: the task must not
@@ -1236,6 +1286,30 @@ class GcsServer:
             rec_spec = self._recover_inflight.pop(task_id, None)
             if spec is None:
                 spec = rec_spec
+        done_epoch = msg.get("actor_epoch")
+        if (
+            done_epoch is not None
+            and spec is not None
+            and spec.actor_id is not None
+        ):
+            actor = self.actors.get(spec.actor_id.binary())
+            if actor is not None and actor.epoch != done_epoch:
+                # Epoch fence: this record was produced by a superseded
+                # incarnation of the actor (false death → restart). Its
+                # returns were already resolved when that incarnation
+                # died (failed with RayActorError, or re-run under the
+                # live epoch) — applying it would let a caller observe
+                # results from two incarnations of one actor.
+                if _events.enabled():
+                    _events.record(
+                        _events.HEAD, spec.actor_id.hex(),
+                        "ACTOR_EPOCH_FENCED",
+                        {
+                            "stale": done_epoch, "current": actor.epoch,
+                            "task": task_id.hex()[:12],
+                        },
+                    )
+                return
         self.task_events.append(
             (
                 task_id,
@@ -1427,6 +1501,17 @@ class GcsServer:
 
     def _h_put_object(self, state, msg):
         with self._lock:
+            cid = state.get("client_id")
+            fw = self.workers.get(cid) if cid is not None else None
+            if fw is not None and fw.state == W_DEAD:
+                # Fenced putter: a zombie's advert lands AFTER its death
+                # was processed (objects freed, actors restarted) — the
+                # setdefault below would resurrect a freed id as a ghost
+                # READY entry pointing at a segment nobody pins.
+                self._fence_dead_client(cid, "object advert from fenced client")
+                if "req_id" in msg:
+                    state["peer"].reply(msg, ok=False, fenced=True)
+                return
             entry = self.objects.setdefault(msg["object_id"], ObjectEntry())
             was_ready = entry.status == READY
             entry.status = READY
@@ -1434,7 +1519,6 @@ class GcsServer:
             # the authoritative refcount in its own process and sends
             # one release edge when it drains; no holder registration
             # happens here or on any later instance churn.
-            cid = state.get("client_id")
             if cid is not None:
                 entry.owner = cid
                 entry.had_holder = True
@@ -1710,6 +1794,14 @@ class GcsServer:
         releases and holder shadows enqueue to the shard flush queues;
         borrow edges relay as one send per owner."""
         cid = msg["client"]
+        with self._lock:
+            fw = self.workers.get(cid)
+            if fw is not None and fw.state == W_DEAD:
+                # Fenced refcount traffic: the death sweep already
+                # retracted this client's edges; replaying its buffered
+                # batch would plant borrow edges that are never removed.
+                self._fence_dead_client(cid, "ref_flush from fenced client")
+                return
         ops: List[tuple] = []
         for oid in msg.get("release", ()):
             ops.append(("release", oid, cid))
@@ -2035,6 +2127,15 @@ class GcsServer:
         until return_lease or worker death."""
         res = {k: v for k, v in msg.get("resources", {}).items() if v > 0}
         with self._lock:
+            rid = state.get("client_id")
+            rw = self.workers.get(rid) if rid is not None else None
+            if rw is not None and rw.state == W_DEAD:
+                # Fenced lessee: granting to a declared-dead client would
+                # strand the worker until its conn (already presumed
+                # gone) closes — and a zombie must not run new work.
+                self._fence_dead_client(rid, "lease request from fenced client")
+                state["peer"].reply(msg, ok=False, fenced=True)
+                return
             lessee_node = self.nodes.get(state.get("obj_node_id", b""))
             for node in self.nodes.values():
                 if not node.alive or not node.schedulable:
@@ -2245,6 +2346,7 @@ class GcsServer:
                         "node_id": n.node_id.binary(),
                         "label": n.label,
                         "alive": n.alive,
+                        "incarnation": n.incarnation,
                         "total": dict(n.total),
                         "available": dict(n.available),
                     }
@@ -2669,10 +2771,21 @@ class GcsServer:
         """A node daemon (raylet.py) joined over the network control
         plane (reference: GcsNodeManager::HandleRegisterNode)."""
         peer: PeerConn = state["peer"]
+        peer.peer_role = "raylet"
         with self._lock:
             # Reconnecting daemons keep their node id (head restart —
             # reference: raylets re-register after NotifyGCSRestart).
             nid = msg.get("node_id")
+            if nid and nid in self._fenced_node_ids:
+                # Zombie: this node_id was declared dead by the sweeper.
+                # It must NOT resurrect — the daemon self-fences (kills
+                # leased workers, drops shm adverts) and rejoins with a
+                # fresh node_id through the normal join path.
+                self._record_fence(
+                    "node", nid, "dead node_id re-registration"
+                )
+                peer.reply(msg, ok=False, fenced=True)
+                return
             node = NodeState(
                 node_id=NodeID(nid) if nid else NodeID.from_random(),
                 total=dict(msg["resources"]),
@@ -2680,8 +2793,10 @@ class GcsServer:
                 label=msg.get("label", ""),
                 conn=peer,
                 transfer_addr=msg.get("transfer_addr", ""),
-                last_heartbeat=time.time(),
+                last_heartbeat=time.monotonic(),
             )
+            self._incarnation_seq += 1
+            node.incarnation = self._incarnation_seq
             prev = self.nodes.get(node.node_id.binary()) if nid else None
             if prev is not None:
                 # Workers of this node that reconnected BEFORE their
@@ -2709,14 +2824,59 @@ class GcsServer:
             msg,
             ok=True,
             node_id=node.node_id.binary(),
+            incarnation=node.incarnation,
             session_dir=self.session_dir,
         )
         self._publish(
             "NODE_INFO",
             node.node_id.hex(),
             {"state": "ALIVE", "label": node.label,
+             "incarnation": node.incarnation,
              "resources": dict(node.total)},
         )
+
+    def _record_fence(self, kind: str, entity: bytes, reason: str) -> None:
+        """One NODE_FENCED flight-recorder event per rejection site
+        (cheap: fencing is the exception path by construction)."""
+        if _events.enabled():
+            _events.record(
+                _events.HEAD, f"{kind}-{entity.hex()[:12]}",
+                "NODE_FENCED", {"kind": kind, "reason": reason},
+            )
+
+    def _fence_push(self, state, kind: str, entity: bytes,
+                    reason: str) -> None:
+        """Reject a stale-incarnation message: record the fence and tell
+        the sender ONCE per connection (the zombie self-fences on
+        receipt; repeating the push per dropped message would spam a
+        healed link)."""
+        self._record_fence(kind, entity, reason)
+        if state.get("fence_sent"):
+            return
+        state["fence_sent"] = True
+        try:
+            state["peer"].send(
+                {"type": "fenced", "kind": kind, "reason": reason}
+            )
+        except ConnectionLost:
+            pass
+
+    def _fence_dead_client(self, wid: bytes, reason: str) -> None:
+        """Caller holds the lock: a message arrived from a client whose
+        handle is W_DEAD (zombie past false death). Record the fence
+        and push one ``fenced`` notice on its conn so it self-fences."""
+        self._record_fence("worker", wid, reason)
+        if wid in self._fence_pushed:
+            return
+        self._fence_pushed.add(wid)
+        conn = self.client_conns.get(wid)
+        if conn is not None:
+            try:
+                conn.send(
+                    {"type": "fenced", "kind": "worker", "reason": reason}
+                )
+            except ConnectionLost:
+                pass
 
     def _h_node_heartbeat(self, state, msg):
         self._ingest_peer_events(
@@ -2724,8 +2884,24 @@ class GcsServer:
         )
         with self._lock:
             node = self.nodes.get(msg["node_id"])
+            inc = msg.get("incarnation")
+            stale = node is None or not node.alive or (
+                inc is not None
+                and node.incarnation
+                and inc != node.incarnation
+            )
+        if stale:
+            # Unknown, dead, or stale-incarnation node: a heartbeat
+            # must not refresh liveness (a zombie would never be
+            # declared dead) — fence the sender instead.
+            self._fence_push(
+                state, "node", msg["node_id"], "stale heartbeat"
+            )
+            return
+        with self._lock:
+            node = self.nodes.get(msg["node_id"])
             if node is not None:
-                node.last_heartbeat = time.time()
+                node.last_heartbeat = time.monotonic()
                 # Periodic resource-view sync (reference: ray_syncer.h
                 # resource broadcasting): CPUs the daemon leased out
                 # locally come off this node's schedulable view,
@@ -3583,14 +3759,10 @@ class GcsServer:
             for wid in stuck:
                 self._handle_worker_death(wid, "died during startup")
             with self._lock:
-                stale = [
-                    n.node_id.binary()
-                    for n in self.nodes.values()
-                    if n.alive
-                    and n.conn is not None
-                    and n.last_heartbeat > 0
-                    and now - n.last_heartbeat > period * threshold
-                ]
+                stale = stale_node_ids(
+                    self.nodes.values(), time.monotonic(),
+                    period, threshold,
+                )
             for nid in stale:
                 self._handle_node_death(
                     nid, "node heartbeat timed out (unreachable or hung)"
@@ -3764,6 +3936,7 @@ class GcsServer:
                 else:
                     if not detached:
                         actor.restarts_used += 1
+                    actor.epoch += 1  # fence the old incarnation
                     actor.worker_id = None
                     if not any(
                         s.actor_creation
@@ -3836,6 +4009,15 @@ class GcsServer:
             if node is None or not node.alive:
                 return
             node.alive = False
+            # Arm the membership fence: any message still carrying this
+            # incarnation — or this node_id at all — is now stale. The
+            # id joins the fenced set so a zombie's re-registration is
+            # rejected and it rejoins with a fresh identity.
+            node.incarnation += 1
+            self._incarnation_seq = max(
+                self._incarnation_seq + 1, node.incarnation
+            )
+            self._fenced_node_ids.add(nid)
             if node.conn is not None:
                 self._daemon_conn_count = max(0, self._daemon_conn_count - 1)
             node.conn = None
@@ -4647,6 +4829,7 @@ class GcsServer:
                         # Restart state machine (reference: GcsActorManager,
                         # design doc actor_states.rst ALIVE -> RESTARTING).
                         actor.restarts_used += 1
+                        actor.epoch += 1  # fence the old incarnation
                         actor.state = A_RESTARTING
                         actor.worker_id = None
                         self._pending.append(actor.spec)
@@ -4726,6 +4909,25 @@ class GcsServer:
         for oid in segs:
             self._store.delete(oid)
         self._store.close()
+
+
+def stale_node_ids(nodes, now_mono: float, period_s: float,
+                   threshold: float) -> List[bytes]:
+    """Heartbeat-timeout sweep decision (pure; unit-tested).
+
+    ``now_mono`` and ``NodeState.last_heartbeat`` are BOTH
+    time.monotonic() readings: liveness must never consult the wall
+    clock, or an NTP step / VM resume would mass-declare live nodes
+    dead (reference: GcsHealthCheckManager counts missed probes, it
+    does not diff wall timestamps)."""
+    return [
+        n.node_id.binary()
+        for n in nodes
+        if n.alive
+        and n.conn is not None
+        and n.last_heartbeat > 0
+        and now_mono - n.last_heartbeat > period_s * threshold
+    ]
 
 
 def _drop_spill_file(entry: "ObjectEntry") -> None:

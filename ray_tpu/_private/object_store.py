@@ -596,6 +596,32 @@ class ObjectStore:
         except BufferError:
             pass
 
+    def detach_pool(self) -> None:
+        """Drop the node-pool attachment, keeping the store usable on
+        the per-object segment fallback. Used by the raylet's zombie
+        self-fence: a declared-dead node's segment must stop backing
+        new puts and shm adverts, but the daemon itself lives on as a
+        fresh incarnation."""
+        if self._pool is None:
+            return
+        with self._lock:
+            refs = dict(self._pool_refs)
+            self._pool_refs.clear()
+        for oid, n in refs.items():
+            for _ in range(n):
+                try:
+                    self._pool.release(oid)
+                except Exception:  # noqa: BLE001 - counted, never silent
+                    self._detach_errors = getattr(
+                        self, "_detach_errors", 0
+                    ) + 1
+                    break
+        try:
+            self._pool.close()
+        except Exception:  # noqa: BLE001 - counted, never silent
+            self._detach_errors = getattr(self, "_detach_errors", 0) + 1
+        self._pool = None
+
     def close(self) -> None:
         if self._pool is not None:
             # Drain held refcounts or the shared pool pins these objects

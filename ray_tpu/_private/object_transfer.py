@@ -62,6 +62,12 @@ class ObjectTransferServer:
         self.address = transport.listener_address(self._listener)
         self._peers = []
         self._shutdown = False
+        # Zombie fence (membership protocol): once the owning raylet
+        # learns it was declared dead, its segment adverts are stale —
+        # shm_locate must stop naming the pool so no NEW pull can map
+        # a segment the fleet already considers gone. Chunk pulls keep
+        # working: they copy bytes, they never hand out the mapping.
+        self.shm_fenced = False
         # Spill files already checksum-verified by this server, keyed
         # (path, size, mtime_ns): spill files are immutable once
         # renamed into place, so one streaming CRC pass covers every
@@ -100,6 +106,10 @@ class ObjectTransferServer:
             # holds the object so a consumer on THIS host can map it and
             # copy once — zero bytes over the socket. A consumer on
             # another host sees the host-id mismatch and pulls chunks.
+            if self.shm_fenced:
+                peer.reply(msg, ok=False, fenced=True,
+                           error="provider fenced", host=_host_id())
+                return
             src = self._store.shm_source(ObjectID(msg["object_id"]))
             if src is None:
                 peer.reply(msg, ok=False, error="no shm source",
@@ -171,6 +181,12 @@ class ObjectTransferServer:
             peer.reply(msg, ok=True, data=data, size=size)
         finally:
             self._store.release_raw(oid)
+
+    def fence_shm(self):
+        """Permanently stop answering shm_locate with this node's pool
+        (zombie self-fence). Not reversible: the re-registered
+        incarnation runs on per-object segments (or a fresh daemon)."""
+        self.shm_fenced = True
 
     def shutdown(self):
         self._shutdown = True

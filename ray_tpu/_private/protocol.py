@@ -71,6 +71,12 @@ class PeerConn:
         # the handshake before the first frame (a connect storm of N
         # workers then authenticates on N threads, not one).
         self._handshake = handshake
+        # Remote process role (head|raylet|worker|driver) when known —
+        # set by creators (client/raylet head conns) or stamped by the
+        # GCS at hello/register_node. The chaos partition primitive
+        # consults it on both the send and deliver sides; None (role
+        # unknown) always passes.
+        self.peer_role: Optional[str] = None
         self._send_lock = threading.Lock()
         self._out: List[Any] = []
         self._pending: Dict[int, Future] = {}
@@ -129,6 +135,16 @@ class PeerConn:
         if not out:
             return
         self._out = []
+        if self.peer_role is not None:
+            sched = _chaos._active
+            if sched is not None and sched.partition_blocks(
+                _chaos.current_role(), self.peer_role
+            ):
+                # Partitioned link: frames vanish in flight while the
+                # TCP connection stays ESTABLISHED (the gray failure a
+                # heartbeat sweeper must catch — no ConnectionLost, no
+                # EOF, requests just time out).
+                return
         msg = out[0] if len(out) == 1 else ("B", out)
         try:
             if _fp is not None:
@@ -239,6 +255,14 @@ class PeerConn:
         sched = _chaos._active
         if sched is None:
             self._deliver_one(msg)
+            return
+        if self.peer_role is not None and sched.partition_blocks(
+            self.peer_role, _chaos.current_role()
+        ):
+            # Incoming half of a cut link: frames already in flight (or
+            # sent by a peer whose processes don't carry the partition
+            # spec) are dropped on arrival — this is what makes a
+            # one-sided install cut both directions.
             return
         # Chaos engine: the transport boundary — one message in may
         # deliver zero (drop/held), one, or several (dup/released

@@ -536,6 +536,27 @@ FAILOVER_SMOKE = {
 }
 
 
+# Partition soak (membership fencing, ISSUE 18): one victim node is
+# link-cut from the head past the death threshold while it holds a
+# restartable actor, leased tasks, and owned objects, then healed. The
+# partition spec is installed ONLY in the victim daemon's environment
+# (its workers inherit it); send+deliver enforcement cuts both
+# directions of the victim's head links while the rest of the fleet —
+# including the victim's DATA plane — stays connected: the gray
+# failure. Windows are anchored to a shared epoch exported just before
+# the victim boots.
+PARTITION_FULL = {
+    "nodes": 2, "seed": 0x9A127, "partition_start_s": 6.0,
+    "heal_after_s": 14.0, "seconds": 150, "head_kills": 1,
+    "payload_bytes": 64 << 10, "get_timeout_s": 120.0,
+}
+PARTITION_SMOKE = {
+    "nodes": 1, "seed": 0x9A127, "partition_start_s": 5.0,
+    "heal_after_s": 12.0, "seconds": 120, "head_kills": 1,
+    "payload_bytes": 32 << 10, "get_timeout_s": 90.0,
+}
+
+
 @ray_tpu.remote(num_cpus=1)
 def _envelope_fetch(x):
     """Broadcast consumer: materializing the arg IS the transfer."""
@@ -1418,6 +1439,403 @@ def bench_head_failover(cfg: Dict[str, float]):
         shutil.rmtree(session_dir, ignore_errors=True)
 
 
+@ray_tpu.remote(max_restarts=10, num_cpus=1, resources={"victim": 1})
+class _EpochCounter:
+    """Epoch-stamped counter for the partition soak: every reply
+    carries a per-incarnation boot token, so the driver can prove it
+    never observed two incarnations interleaved — the at-most-once
+    guarantee epoch fencing provides across false death. Pinned to the
+    victim node by custom resource; after the zombie self-fences and
+    rejoins, the restart lands on the NEW incarnation of that node."""
+
+    def __init__(self):
+        import secrets as _secrets
+
+        self.token = _secrets.token_hex(4)
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+        return (self.token, self.n)
+
+
+def bench_partition_soak(cfg: Dict[str, float]):
+    """Seeded partition soak (acceptance: ISSUE 18): a victim node is
+    partitioned from the head past the death threshold while holding a
+    restartable epoch-stamped actor, in-flight tasks, and owned
+    objects, then healed — asserting (a) the head declares it dead and
+    fences its stale traffic, (b) the zombie self-fences and rejoins
+    as a NEW incarnation (fresh node_id, higher incarnation), (c) the
+    driver never observes two actor incarnations interleaved (zero
+    duplicate side effects), (d) zero wedged gets, (e) no resurrected
+    freed objects (directory converges to baseline), and (f) the whole
+    sequence composes with a head failover (PR 4) in the same soak.
+    Deterministic per seed; a red run reproduces with the printed
+    seed."""
+    import gc
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from ray_tpu.cluster_utils import DaemonCluster, SupervisedHead
+    from ray_tpu._private import chaos as _chaos
+    from ray_tpu._private.state import list_cluster_events
+    from ray_tpu._private.worker import global_client
+    from ray_tpu.exceptions import GetTimeoutError
+
+    seed = int(cfg["seed"])
+    start_s = float(cfg["partition_start_s"])
+    heal_after = float(cfg["heal_after_s"])
+    seconds = float(cfg["seconds"])
+    get_timeout = float(cfg["get_timeout_s"])
+    payload_n = max(1024, int(cfg["payload_bytes"]) // 8)
+    spec = (
+        f"partition:raylet<->head={start_s:g}:{heal_after:g},"
+        f"partition:worker<->head={start_s:g}:{heal_after:g}"
+    )
+    print(
+        f"partition_soak: seed={seed} (reproduce with "
+        f"--only partition_soak --chaos-seed {seed})"
+    )
+    print(f"partition_soak: victim spec={spec}")
+
+    # External head: the composability leg SIGKILLs it mid-soak.
+    ray_tpu.shutdown()
+    session_dir = tempfile.mkdtemp(prefix="rtpu_partition_")
+    try:
+        head = SupervisedHead(session_dir=session_dir)
+    except (RuntimeError, TimeoutError, OSError) as e:
+        RESULTS["partition_soak_skipped"] = 1.0  # counted, never silent
+        print(f"partition_soak: SKIPPED — cannot launch external head: {e}")
+        return
+    cluster = None
+    stop = threading.Event()
+    stats = {"ok": 0, "failed": 0, "actor_ok": 0}
+    # Swallowed-fault accounting for the poll/teardown excepts below.
+    soak_errors = {"nodes_poll": 0, "final_bump": 0, "teardown": 0}
+    wedged: List[str] = []
+    problems: List[str] = []
+    bumps: List[tuple] = []  # (token, n) in observation order
+    try:
+        ray_tpu.init(address=head.address)
+        client = global_client()
+        cluster = DaemonCluster.attach(head.tcp_address, head.authkey)
+        for i in range(int(cfg["nodes"])):
+            cluster.add_node(num_cpus=2, label=f"pt{i}")
+        # Shared partition clock: exported via env ONLY to the victim
+        # daemon (its workers inherit it), anchored right before boot.
+        epoch = time.time()
+        cluster.add_node(
+            num_cpus=2,
+            resources={"victim": 4.0},
+            label="victim",
+            env={
+                "RAY_TPU_chaos_spec": spec,
+                "RAY_TPU_chaos_seed": str(seed),
+                "RAY_TPU_chaos_epoch": str(epoch),
+            },
+        )
+        victim_id = next(
+            n["node_id"] for n in ray_tpu.nodes() if n["label"] == "victim"
+        )
+        victim_inc = next(
+            n["incarnation"] for n in ray_tpu.nodes()
+            if n["label"] == "victim"
+        )
+
+        # Victim-held state: the epoch counter actor, plus owned
+        # objects sealed in the victim's segment (tasks pinned there by
+        # the custom resource).
+        counter = _EpochCounter.options(
+            name="partition_counter", lifetime="detached"
+        ).remote()
+        tok0, _ = ray_tpu.get(counter.bump.remote(), timeout=60)
+        bumps.append((tok0, 1))
+        victim_refs = [
+            _chaos_chew.options(resources={"victim": 1}).remote(
+                np.ones(payload_n) * i
+            )
+            for i in range(4)
+        ]
+        ray_tpu.get(victim_refs, timeout=60)
+        gc.collect()
+        client._tracker.flush(client)
+        time.sleep(1.0)
+
+        def entry_count() -> int:
+            r = client.state_read(
+                {"type": "list_state", "kind": "objects", "limit": 1}
+            )
+            return int(r.get("total", 0))
+
+        baseline_entries = entry_count()
+        wedged_refs: List = []
+
+        def _attribute_wedge(tag: str, ref, exc) -> None:
+            wedged.append(f"{tag}: {exc}")
+            wedged_refs.append((tag, ref))
+
+        def traffic(idx: int):
+            lrng = random.Random(seed ^ (idx + 1))
+            base = np.ones(payload_n)
+            bo = _chaos.Backoff(base_s=0.2, cap_s=1.5, rng=lrng)
+            while not stop.is_set():
+                try:
+                    ref = ray_tpu.put(base * lrng.random())
+                    r1 = _chaos_chew.remote(ref)
+                    out = ray_tpu.get(r1, timeout=get_timeout)
+                    assert len(out) > 0
+                    stats["ok"] += 1
+                    bo.reset()
+                    del ref, r1, out
+                except GetTimeoutError as e:
+                    _attribute_wedge(f"traffic[{idx}]", r1, e)
+                    return
+                except Exception:  # noqa: BLE001 - death window
+                    stats["failed"] += 1
+                    bo.sleep()
+
+        def actor_loop():
+            bo = _chaos.Backoff(
+                base_s=0.3, cap_s=2.0, rng=random.Random(seed)
+            )
+            while not stop.is_set():
+                ref = None
+                try:
+                    ref = counter.bump.remote()
+                    tok, n = ray_tpu.get(ref, timeout=get_timeout)
+                    bumps.append((tok, n))
+                    stats["actor_ok"] += 1
+                    bo.reset()
+                    time.sleep(0.2)
+                except GetTimeoutError as e:
+                    _attribute_wedge("actor", ref, e)
+                    return
+                except Exception:  # noqa: BLE001 - restart window
+                    stats["failed"] += 1
+                    bo.sleep()
+
+        threads = [
+            threading.Thread(target=traffic, args=(i,), daemon=True)
+            for i in range(2)
+        ] + [threading.Thread(target=actor_loop, daemon=True)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+
+        def victim_rows():
+            try:
+                return [
+                    n for n in ray_tpu.nodes() if n["label"] == "victim"
+                ]
+            except Exception:  # noqa: BLE001 - mid-failover
+                soak_errors["nodes_poll"] += 1
+                return None
+
+        def await_(pred, deadline_s, what) -> bool:
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline and not wedged:
+                if pred():
+                    return True
+                time.sleep(0.5)
+            problems.append(f"timeout: {what}")
+            return False
+
+        # Phase 1 — false death: the cut begins at epoch+start_s; the
+        # monotonic sweeper must declare the victim dead soon after the
+        # threshold, with NOTHING crashed (the daemon is alive).
+        def victim_gone():
+            rows = victim_rows()
+            return rows is not None and not any(
+                r["node_id"] == victim_id for r in rows
+            )
+
+        declared = await_(
+            victim_gone, start_s + heal_after + 30,
+            "victim never declared dead under partition",
+        )
+        if declared:
+            print(
+                f"partition_soak: victim declared dead at "
+                f"+{time.time() - epoch:.1f}s"
+            )
+            # Free the victim-owned objects while their only copy is on
+            # the declared-dead node: a zombie advert after the heal
+            # must NOT resurrect them (checked by the directory
+            # converging to baseline below).
+            del victim_refs
+            gc.collect()
+            client._tracker.flush(client)
+
+        # Phase 2 — heal + fence + rejoin: the zombie's first frames
+        # after epoch+start_s+heal_after get FENCED replies; it drains
+        # and re-registers as a fresh incarnation of the same label.
+        def victim_back():
+            rows = victim_rows()
+            return rows is not None and any(
+                r["node_id"] != victim_id and r["incarnation"] > victim_inc
+                for r in rows
+            )
+
+        rejoined = declared and await_(
+            victim_back, heal_after + 60,
+            "victim never rejoined as a new incarnation",
+        )
+        if rejoined:
+            row = [
+                r for r in victim_rows() if r["node_id"] != victim_id
+            ][0]
+            print(
+                f"partition_soak: victim rejoined at "
+                f"+{time.time() - epoch:.1f}s as "
+                f"{row['node_id'].hex()[:8]} "
+                f"(incarnation {row['incarnation']}, was {victim_inc})"
+            )
+
+        # Membership events must be visible BEFORE the head kill (the
+        # recorder does not survive a head restart).
+        fence_events: set = set()
+        if rejoined:
+            def fences_visible():
+                evs = list_cluster_events(category="head", limit=10_000)
+                for e in evs:
+                    fence_events.add(e["event"])
+                return {"NODE_FENCED", "ZOMBIE_SELF_FENCE"} <= fence_events
+
+            await_(
+                fences_visible, 30,
+                "fence flight-recorder events never surfaced",
+            )
+
+        # Phase 3 — head-failover composability (PR 4): SIGKILL the
+        # head after the fleet healed; everything must reconverge.
+        kills = 0
+        if rejoined and int(cfg["head_kills"]) > 0:
+            restarts_before = head.restarts
+            head.kill()
+            kills = 1
+            print("partition_soak: killed head (composability leg)")
+            if not head.wait_restarted(restarts_before + 1, timeout=60):
+                wedged.append("head never restarted")
+
+        # Let traffic run out the remaining budget (bounded).
+        remaining = seconds - (time.perf_counter() - t0)
+        deadline = time.monotonic() + max(5.0, min(remaining, 30.0))
+        while time.monotonic() < deadline and not wedged:
+            time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join(timeout=get_timeout + 60)
+            if t.is_alive():
+                wedged.append(f"{t.name} did not finish after stop")
+        soak_s = time.perf_counter() - t0
+
+        # ---------------------------------------------------- assertions
+        final_bump = None
+        try:
+            final_bump = ray_tpu.get(counter.bump.remote(), timeout=90)
+        except Exception:  # noqa: BLE001
+            soak_errors["final_bump"] += 1
+        gc.collect()
+        client._tracker.flush(client)
+        leak_deadline = time.monotonic() + 60
+        leaked = entry_count() - baseline_entries
+        while time.monotonic() < leak_deadline and leaked > 16:
+            gc.collect()
+            client._tracker.flush(client)
+            time.sleep(1.0)
+            leaked = entry_count() - baseline_entries
+
+        # Epoch interleave check: once a new token appears, the old
+        # incarnation must never answer again; within a token, the
+        # counter is strictly increasing.
+        tokens_in_order: List[str] = []
+        interleaved = False
+        monotonic_ok = True
+        last_n: Dict[str, int] = {}
+        for tok, n in bumps:
+            if tok not in tokens_in_order:
+                tokens_in_order.append(tok)
+            elif tok != tokens_in_order[-1]:
+                interleaved = True
+            if n <= last_n.get(tok, 0):
+                monotonic_ok = False
+            last_n[tok] = n
+
+        RESULTS["partition_soak_seconds"] = round(soak_s, 1)
+        RESULTS["partition_soak_ops_ok"] = stats["ok"] + stats["actor_ok"]
+        RESULTS["partition_soak_ops_failed"] = stats["failed"]
+        RESULTS["partition_soak_incarnations"] = len(tokens_in_order)
+        RESULTS["partition_soak_leaked_entries"] = max(0, leaked)
+        print(
+            f"partition_soak: {soak_s:.0f}s, ops ok={stats['ok']}"
+            f"+{stats['actor_ok']} failed={stats['failed']}, "
+            f"actor incarnations={tokens_in_order}, "
+            f"final bump={final_bump}, head kills={kills}, "
+            f"leaked entries={max(0, leaked)}, "
+            f"membership events={sorted(fence_events)}"
+        )
+        for tag, ref in wedged_refs:
+            if ref is None:
+                continue
+            try:
+                oid = ref.id().hex()
+                r = client.state_read(
+                    {"type": "list_state", "kind": "objects",
+                     "limit": 200_000}
+                )
+                ent = [i for i in r.get("items", [])
+                       if i["object_id"] == oid]
+                print(f"partition_soak: wedged {tag} oid={oid} entry={ent}")
+            except Exception as e:  # noqa: BLE001
+                print(f"partition_soak: wedge attribution failed: {e}")
+        if wedged:
+            problems.append(f"wedged futures: {wedged}")
+        if stats["ok"] < 10:
+            problems.append(f"traffic starved: only {stats['ok']} ops")
+        if interleaved:
+            problems.append(
+                f"actor incarnations interleaved (duplicate side "
+                f"effects observable): {tokens_in_order}"
+            )
+        if not monotonic_ok:
+            problems.append("actor counter not monotonic within an epoch")
+        if declared and rejoined and len(tokens_in_order) < 2:
+            problems.append(
+                "actor never restarted onto the new incarnation"
+            )
+        if final_bump is None:
+            problems.append("actor not callable after heal + failover")
+        if leaked > 16:
+            problems.append(
+                f"{leaked} directory entries leaked (resurrected "
+                f"freed objects?)"
+            )
+        if problems:
+            RESULTS["partition_soak_ok"] = 0.0
+            raise RuntimeError(
+                f"partition_soak FAILED (seed={seed}; reproduce with "
+                f"--only partition_soak --chaos-seed {seed}): "
+                + "; ".join(problems)
+            )
+        RESULTS["partition_soak_ok"] = 1.0
+    finally:
+        stop.set()
+        if cluster is not None:
+            for proc in list(cluster._daemons):
+                try:
+                    cluster.kill_node(proc)
+                except Exception:  # noqa: BLE001
+                    soak_errors["teardown"] += 1
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            soak_errors["teardown"] += 1
+        head.stop()
+        shutil.rmtree(session_dir, ignore_errors=True)
+
+
 @ray_tpu.remote(num_cpus=1, max_retries=2)
 def _pressure_fetch(chunk_refs, small_refs, get_timeout):
     """Pressure-soak consumer: one thread pulls the broadcast chunk
@@ -1817,7 +2235,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--only", default=None,
         help="comma-separated subset: tasks,actors,objects,pgs,scale,"
-        "object_envelope,chaos_soak,head_failover,pressure_soak",
+        "object_envelope,chaos_soak,head_failover,pressure_soak,"
+        "partition_soak",
     )
     parser.add_argument(
         "--envelope-smoke", action="store_true",
@@ -1836,6 +2255,11 @@ def main(argv=None) -> int:
         "--failover-smoke", action="store_true",
         help="short head_failover config: 1 head kill, small cluster, "
         "bounded wall time (make failover-smoke)",
+    )
+    parser.add_argument(
+        "--partition-smoke", action="store_true",
+        help="short partition_soak config: 1 healthy node + 1 victim, "
+        "one cut/heal cycle + 1 head kill (make partition-smoke)",
     )
     parser.add_argument(
         "--pressure-smoke", action="store_true",
@@ -1889,6 +2313,13 @@ def main(argv=None) -> int:
     )
     if args.chaos_seed is not None:
         pressure_cfg["seed"] = args.chaos_seed
+    partition_cfg = dict(
+        PARTITION_SMOKE if args.partition_smoke else PARTITION_FULL
+    )
+    if args.chaos_seed is not None:
+        partition_cfg["seed"] = args.chaos_seed
+    if args.chaos_seconds is not None:
+        partition_cfg["seconds"] = args.chaos_seconds
     groups = {
         "tasks": bench_tasks,
         "actors": bench_actor_calls,
@@ -1899,9 +2330,11 @@ def main(argv=None) -> int:
         "chaos_soak": lambda: bench_chaos_soak(chaos_cfg),
         "head_failover": lambda: bench_head_failover(failover_cfg),
         "pressure_soak": lambda: bench_pressure_soak(pressure_cfg),
+        "partition_soak": lambda: bench_partition_soak(partition_cfg),
     }
     _opt_in = (
-        "object_envelope", "chaos_soak", "head_failover", "pressure_soak"
+        "object_envelope", "chaos_soak", "head_failover",
+        "pressure_soak", "partition_soak",
     )
     selected = (
         [s.strip() for s in args.only.split(",")]

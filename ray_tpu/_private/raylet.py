@@ -58,6 +58,10 @@ class NodeDaemon:
         self._shutdown = threading.Event()
         self._rejoining = False
         self._draining = False
+        # Zombie self-fence in progress (membership protocol): suppresses
+        # the normal rejoin path while this daemon drains its old
+        # incarnation and re-registers as a fresh one.
+        self._fencing = False
         # Fork-server spawning (spawn.py): the zygote starts lazily at
         # the first spawn, inheriting this daemon's env (node ns, pool,
         # local-raylet lease addr are all set before any worker exists).
@@ -67,13 +71,12 @@ class NodeDaemon:
             os.getcwd() + os.pathsep + sys.path[0] + os.pathsep
             + os.environ.get("PYTHONPATH", "")
         )
-        self._spawner = WorkerSpawner(
-            {
-                "RAY_TPU_SESSION_ADDR": gcs_address,
-                "RAY_TPU_AUTHKEY": authkey.hex(),
-                "PYTHONPATH": pythonpath,
-            }
-        )
+        self._spawner_env = {
+            "RAY_TPU_SESSION_ADDR": gcs_address,
+            "RAY_TPU_AUTHKEY": authkey.hex(),
+            "PYTHONPATH": pythonpath,
+        }
+        self._spawner = WorkerSpawner(dict(self._spawner_env))
 
         # Node-local object pool: our own namespace + pool, inherited by
         # the workers we spawn. Set BEFORE the store/transfer server are
@@ -123,6 +126,9 @@ class NodeDaemon:
             on_close=self._on_gcs_close,
             name="raylet",
         )
+        # Partition-chaos role stamp: link cuts are expressed between
+        # named roles, and this conn's far side is the head.
+        self.conn.peer_role = "head"
         reply = self.conn.request(
             {
                 "type": "register_node",
@@ -137,6 +143,9 @@ class NodeDaemon:
             raise RuntimeError(f"node registration failed: {reply}")
         self.node_id: bytes = reply["node_id"]
         self.session_dir: str = reply["session_dir"]
+        # Head-assigned incarnation: stamped on every heartbeat so the
+        # head can fence messages from a declared-dead (zombie) epoch.
+        self.incarnation: int = reply.get("incarnation", 1)
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="raylet-heartbeat", daemon=True
         )
@@ -231,6 +240,13 @@ class NodeDaemon:
         elif mtype == "set_events_recording":
             # Cluster-wide flight-recorder toggle (gcs broadcast).
             _events.get_recorder().enabled = bool(msg.get("enabled", True))
+        elif mtype == "fenced":
+            # The head declared this node dead (partition false-death):
+            # we are a zombie. Drain off the push-dispatch thread — the
+            # fence kills workers and re-registers, both slow.
+            threading.Thread(
+                target=self._self_fence, name="raylet-fence", daemon=True
+            ).start()
         elif mtype == "shutdown":
             self.shutdown()
 
@@ -539,6 +555,7 @@ class NodeDaemon:
                 msg = {
                     "type": "node_heartbeat",
                     "node_id": self.node_id,
+                    "incarnation": self.incarnation,
                     "local_cpus_in_use": float(
                         self._leased_count["cpu"]
                     ),
@@ -578,10 +595,12 @@ class NodeDaemon:
             # One rejoin loop at a time: every closed conn (including
             # failed probes) fires its on_close on its own reader
             # thread; re-entering would race re-registration or exit a
-            # daemon that already rejoined.
-            if self._rejoining:
+            # daemon that already rejoined. A self-fence in flight owns
+            # re-registration outright.
+            if self._rejoining or self._fencing:
                 return
             self._rejoining = True
+        fenced = False
         try:
             deadline = time.time() + max(
                 RayConfig.worker_register_timeout_s,
@@ -605,6 +624,7 @@ class NodeDaemon:
                     push_handler=self._on_push,
                     name="raylet",
                 )
+                conn.peer_role = "head"
                 try:
                     reply = conn.request(
                         {
@@ -628,9 +648,143 @@ class NodeDaemon:
                     )
                     return
                 conn.close()
+                if reply.get("fenced"):
+                    # The head declared this node_id dead while we were
+                    # partitioned: this identity is burned. Stop probing
+                    # with it — drain and re-register as a fresh
+                    # incarnation instead.
+                    fenced = True
+                    break
         finally:
             with self._lock:
                 self._rejoining = False
+        if fenced:
+            self._self_fence()
+            return
+        if not self._shutdown.is_set():
+            self.shutdown()
+            os._exit(0)
+
+    def _self_fence(self):
+        """Zombie drain (membership protocol): the head declared this
+        node dead — its leases were released, its actors restarted
+        elsewhere, its owned objects freed or promoted. Nothing this
+        incarnation holds may act again: kill the worker pool, fence
+        the shm segment out of the locate handshake, then rejoin
+        through the NORMAL node-join path as a brand-new incarnation
+        (fresh node_id, fresh workers). The daemon process survives —
+        a partitioned fleet heals without an external restarter."""
+        if self._shutdown.is_set():
+            return
+        with self._lock:
+            if self._fencing:
+                return
+            self._fencing = True
+        old = self.node_id
+        _events.record(
+            _events.HEAD, f"node-{old.hex()[:12]}", "ZOMBIE_SELF_FENCE",
+            {"incarnation": self.incarnation},
+        )
+        try:
+            # 1. The old incarnation's workers must not produce further
+            # side effects: their results would be fenced head-side
+            # anyway, but a zombie actor could still mutate external
+            # state (files, services) on its own.
+            with self._lock:
+                workers = list(self._workers.values())
+                self._workers.clear()
+                self._local_workers.clear()
+                self._leased_count = {"cpu": 0, "tpu": 0}
+                self._chip_owner.clear()
+            for proc in workers:
+                proc.terminate()
+            deadline = time.time() + 2.0
+            for proc in workers:
+                try:
+                    proc.wait(timeout=max(0.0, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            # 2. Invalidate shm adverts: no NEW pull may map the dead
+            # incarnation's segment (the fleet may already have freed
+            # or reconstructed those objects elsewhere).
+            self.transfer.fence_shm()
+            self.store.detach_pool()
+            if self._pool is not None:
+                try:
+                    self._pool.destroy()
+                except Exception:  # noqa: BLE001 - counted, never silent
+                    self._fence_errors = getattr(
+                        self, "_fence_errors", 0
+                    ) + 1
+                self._pool = None
+            os.environ.pop("RAY_TPU_POOL_NAME", None)
+            # The fork-server zygote inherited the dead pool's name at
+            # its first spawn; restart it so fresh-incarnation workers
+            # boot on the per-object segment fallback.
+            try:
+                self._spawner.shutdown()
+            except Exception:  # noqa: BLE001 - counted, never silent
+                self._fence_errors = getattr(
+                    self, "_fence_errors", 0
+                ) + 1
+            from .spawn import WorkerSpawner
+
+            self._spawner = WorkerSpawner(dict(self._spawner_env))
+            try:
+                self.conn.close()
+            except Exception:  # noqa: BLE001 - counted, never silent
+                self._fence_errors = getattr(
+                    self, "_fence_errors", 0
+                ) + 1
+            # 3. Re-register WITHOUT a node_id: the head mints a fresh
+            # identity + incarnation, exactly as a cold node join.
+            backoff = _chaos.Backoff(base_s=0.25, cap_s=3.0)
+            deadline = time.time() + max(
+                RayConfig.worker_register_timeout_s,
+                RayConfig.gcs_reconnect_budget_s,
+            )
+            while time.time() < deadline and not self._shutdown.is_set():
+                time.sleep(backoff.next_delay())
+                try:
+                    raw = transport.connect(self.gcs_address, self.authkey)
+                except OSError:
+                    continue
+                conn = PeerConn(
+                    raw, push_handler=self._on_push, name="raylet"
+                )
+                conn.peer_role = "head"
+                try:
+                    reply = conn.request(
+                        {
+                            "type": "register_node",
+                            "resources": self.resources,
+                            "transfer_addr": self.transfer.address,
+                            "label": self.label or os.uname().nodename,
+                            "pid": os.getpid(),
+                        },
+                        timeout=RayConfig.worker_register_timeout_s,
+                    )
+                except (ConnectionLost, TimeoutError, OSError):
+                    conn.close()
+                    continue
+                if not reply.get("ok"):
+                    conn.close()
+                    continue
+                self.node_id = reply["node_id"]
+                self.incarnation = reply.get("incarnation", 1)
+                self.conn = conn
+                conn.set_on_close(self._on_gcs_close)
+                sys.stderr.write(
+                    f"raylet: fenced; rejoined as "
+                    f"{self.node_id.hex()[:8]} (incarnation "
+                    f"{self.incarnation}, was {old.hex()[:8]})\n"
+                )
+                for _ in range(min(2, int(self.resources.get("CPU", 0)))):
+                    self._spawn_local_worker()
+                return
+        finally:
+            with self._lock:
+                self._fencing = False
         if not self._shutdown.is_set():
             self.shutdown()
             os._exit(0)

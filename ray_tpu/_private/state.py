@@ -139,21 +139,54 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
         # perturbed, so a failed chaos run is attributable from the
         # timeline alone.
         chaos_events = list_cluster_events(category="chaos", limit=100_000)
+        cuts: Dict[str, Dict[str, Any]] = {}
         for ev in chaos_events:
+            name, entity = ev["event"], ev["entity"]
+            if name == "PARTITION_BEGIN":
+                cuts[entity] = ev
+                continue
+            if name == "PARTITION_HEAL" and entity in cuts:
+                # Membership row (pid "membership"): the cut window a
+                # link pair observed renders as one slice, so fences and
+                # zombie drains line up under the partition that caused
+                # them.
+                t0 = cuts.pop(entity)["timestamp"]
+                trace.append(
+                    {
+                        "name": f"partition:{entity}",
+                        "cat": "membership", "pid": "membership",
+                        "tid": entity, "ph": "X", "ts": t0 * 1e6,
+                        "dur": max(0.0, ev["timestamp"] - t0) * 1e6,
+                        "args": {
+                            **(ev.get("attrs") or {}), "entity": entity,
+                        },
+                    }
+                )
+                continue
             trace.append(
                 {
-                    "name": f"{ev['event']}:{ev['entity']}",
+                    "name": f"{name}:{entity}",
                     "cat": "chaos",
                     "pid": "chaos",
-                    "tid": ev["event"],
+                    "tid": name,
                     "ph": "i",
                     "ts": ev["timestamp"] * 1e6,
                     "s": "g",
                     "args": {
                         **(ev.get("attrs") or {}),
-                        "entity": ev["entity"],
+                        "entity": entity,
                         "source": ev.get("source", ""),
                     },
+                }
+            )
+        # Unhealed cuts (still dark at dump time) stay visible.
+        for entity, ev in cuts.items():
+            trace.append(
+                {
+                    "name": f"partition:{entity}", "cat": "membership",
+                    "pid": "membership", "tid": entity, "ph": "i",
+                    "ts": ev["timestamp"] * 1e6, "s": "g",
+                    "args": {**(ev.get("attrs") or {}), "entity": entity},
                 }
             )
     except Exception:  # noqa: BLE001 - recorder disabled or old head
@@ -186,6 +219,20 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
                         **base, "name": "outage", "ph": "X",
                         "ts": t0 * 1e6,
                         "dur": max(0.0, ev["timestamp"] - t0) * 1e6,
+                    }
+                )
+                continue
+            if name in (
+                "NODE_FENCED", "ACTOR_EPOCH_FENCED", "ZOMBIE_SELF_FENCE"
+            ):
+                # Membership row: every fence decision (head-side stale
+                # rejection, epoch mismatch, zombie drain) renders as an
+                # instant beside the partition slice that provoked it.
+                trace.append(
+                    {
+                        **base, "name": name, "cat": "membership",
+                        "pid": "membership", "ph": "i",
+                        "ts": ev["timestamp"] * 1e6, "s": "g",
                     }
                 )
                 continue

@@ -1059,6 +1059,12 @@ class WorkerRuntime:
             "results": results,
             "error": error_blob,
         }
+        if getattr(spec, "actor_epoch", None) is not None:
+            # Epoch fence (membership protocol): echo the incarnation
+            # this call executed under so the head can reject a result
+            # produced by a falsely-dead actor after its restart —
+            # at-most-once across false death.
+            item["actor_epoch"] = spec.actor_epoch
         pinned_refs = list(spec.dependencies) + list(
             getattr(spec, "borrowed_refs", None) or ()
         )
@@ -1265,6 +1271,11 @@ def main():
                 # the runtime packs the instance and the process outlives
                 # any single actor.
                 s.packed_host = True
+            if msg.get("actor_epoch") is not None:
+                # Rides the message, not the spec pickle (TaskSpec's
+                # positional __reduce__ drops ad-hoc attrs): stamp it
+                # back on so the done record can echo the epoch.
+                s.actor_epoch = msg["actor_epoch"]
             task_queue.put((s, None))
         elif t == "terminate_actor":
             # Force-kill of ONE packed actor on a shared host (the

@@ -296,7 +296,11 @@ class DaemonCluster:
         resources: Optional[Dict[str, float]] = None,
         label: str = "",
         wait: bool = True,
+        env: Optional[Dict[str, str]] = None,
     ) -> subprocess.Popen:
+        """``env`` overlays the daemon's environment — chaos tests use
+        it to install a per-node fault schedule (e.g. a partition spec
+        that only the victim raylet and its workers enforce)."""
         import json
 
         res = {"CPU": float(num_cpus)}
@@ -304,7 +308,11 @@ class DaemonCluster:
             res["TPU"] = float(num_tpus)
         res.update(resources or {})
         before = len(ray_tpu.nodes())
-        env = {**os.environ, "PYTHONPATH": _pinned_pythonpath()}
+        env = {
+            **os.environ,
+            "PYTHONPATH": _pinned_pythonpath(),
+            **(env or {}),
+        }
         proc = subprocess.Popen(
             [
                 sys.executable,

@@ -260,6 +260,71 @@ def test_same_host_pull_rides_shm_not_socket(monkeypatch):
         consumer_pool.destroy()
 
 
+def test_fenced_provider_segment_not_attachable(monkeypatch):
+    """Membership fencing drive-by (ISSUE 18): once a raylet learns it
+    was declared dead it fences its transfer server — shm_locate must
+    stop naming the pool, so no NEW pull can map a segment the fleet
+    already considers gone (the head may have freed the ids, and a
+    fresh incarnation may recycle the pool). Pulls degrade to the
+    chunked copy path — bytes, never the mapping — and complete."""
+    import secrets
+
+    from ray_tpu._private.object_transfer import (
+        ObjectFetcher, ObjectTransferServer,
+    )
+
+    prov_name = f"/rtpu_fprov_{os.getpid()}"
+    cons_name = f"/rtpu_fcons_{os.getpid()}"
+    provider_pool = PoolStore(prov_name, create=True, pool_bytes=8 << 20)
+    consumer_pool = PoolStore(cons_name, create=True, pool_bytes=8 << 20)
+    authkey = secrets.token_bytes(8)
+    server = fetcher = None
+    try:
+        monkeypatch.setenv("RAY_TPU_POOL_NAME", prov_name)
+        provider_store = ObjectStore()
+        monkeypatch.setenv("RAY_TPU_POOL_NAME", cons_name)
+        consumer_store = ObjectStore()
+
+        oid = ObjectID(_oid((os.getpid() << 16) + 78))
+        arr = np.random.RandomState(4).rand(1 << 16)  # 512 KiB
+        loc, _ = provider_store.put(oid, arr)
+        assert loc == "pool"
+
+        server = ObjectTransferServer(
+            provider_store, "127.0.0.1:0", authkey
+        )
+        server.fence_shm()
+        fetcher = ObjectFetcher(consumer_store, authkey)
+        # The boot-id handshake answers fenced — the provider's pool
+        # name never crosses the wire, so there is nothing to attach.
+        conn = fetcher._conn_for(server.address)
+        reply = conn.request(
+            {"type": "shm_locate", "object_id": oid.binary()},
+            timeout=10.0,
+        )
+        assert reply.get("ok") is False and reply.get("fenced") is True
+        assert "pool" not in reply, f"fenced locate leaked pool: {reply}"
+        # A new pull still completes — over chunks, never the mapping.
+        chunk_pulls = []
+        real_chunks = fetcher._pull_chunks
+
+        def _counted(*a, **k):
+            chunk_pulls.append(1)
+            return real_chunks(*a, **k)
+
+        monkeypatch.setattr(fetcher, "_pull_chunks", _counted)
+        assert fetcher.pull(oid, server.address, timeout=20.0)
+        assert chunk_pulls, "pull bypassed the fence"
+        np.testing.assert_array_equal(consumer_store.get(oid), arr)
+    finally:
+        if fetcher is not None:
+            fetcher.close()
+        if server is not None:
+            server.shutdown()
+        provider_pool.destroy()
+        consumer_pool.destroy()
+
+
 def test_pool_full_hands_off_to_segment_ladder(monkeypatch):
     """Pool exhaustion must degrade to per-object segments (the spill
     ladder's first rung), never fail the put."""
