@@ -4,10 +4,11 @@
 
 .PHONY: ci native lint raylint raylint-baseline race-smoke test \
 	obs-smoke envelope-smoke chaos-smoke failover-smoke \
-	pressure-smoke shm-smoke partition-smoke stress clean
+	pressure-smoke shm-smoke partition-smoke straggler-smoke \
+	stress clean
 
 ci: native lint test obs-smoke envelope-smoke chaos-smoke failover-smoke \
-	pressure-smoke race-smoke shm-smoke partition-smoke
+	pressure-smoke race-smoke shm-smoke partition-smoke straggler-smoke
 
 native:
 	$(MAKE) -C native
@@ -128,6 +129,28 @@ partition-smoke:
 	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
 		--only partition_soak --partition-smoke \
 		--out /tmp/ray_tpu_partition_smoke.json
+
+# Straggler soak, short + seeded (2 healthy daemons + 1 gray victim:
+# alive and heartbeating but with task execution stretched 50x and its
+# transfer plane later throttled to 1 MiB/s). Asserts the health
+# scorer suspects then quarantines the victim (drain, not fence),
+# hedged twins keep task p99 within 3x the all-healthy baseline,
+# every hedged pair resolves to exactly one accepted done (the
+# resource ledger never over-credits), throttled multi-chunk pulls
+# re-lead (PULL_RELEAD) instead of wedging and deliver correct bytes,
+# hedging stays <= 1% launch rate while healthy, the victim is
+# readmitted after heal, and the sequence composes with one
+# supervised-head SIGKILL. A red run reproduces with
+#   python -m ray_tpu._private.ray_perf --only straggler_soak \
+#       --straggler-smoke --chaos-seed <printed seed>
+# A host that cannot launch the external head records an explicit
+# straggler_soak_skipped row — counted, never silent. The full
+# >=100-pair soak:
+#   python -m ray_tpu._private.ray_perf --only straggler_soak
+straggler-smoke:
+	JAX_PLATFORMS=cpu python -m ray_tpu._private.ray_perf \
+		--only straggler_soak --straggler-smoke \
+		--out /tmp/ray_tpu_straggler_smoke.json
 
 # Memory-pressure soak, scaled down (a 32 MiB broadcast chunk train to
 # 8 real daemon nodes concurrent with hundreds of small gets, under a

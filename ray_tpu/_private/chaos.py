@@ -42,6 +42,28 @@ comma-separated entries):
         process, the hook site injects the named failure (EIO, ENOSPC,
         a truncated file) and the degradation ladder must absorb it.
 
+    throttle:<roleA><-><roleB>=<bytes_per_s>[:<start_s>[:<heal_after_s>]][?dir=...]
+        Sustained bandwidth degradation between two process roles: a
+        token bucket at BOTH PeerConn boundaries (sender paces before
+        the write, receiver paces after the read) limits the link to
+        ``bytes_per_s`` from ``start_s`` until ``start_s +
+        heal_after_s`` (no heal term = degraded forever). This is the
+        gray failure a binary partition cannot model: every frame
+        still arrives, heartbeats keep landing, but 10-100x late —
+        the straggler substrate the health scorer and hedging layer
+        must catch. Windows share the partition epoch
+        (``RAY_TPU_chaos_epoch``); pacing is a pure function of bytes
+        seen, so a seeded run replays. Transitions record
+        THROTTLE_BEGIN / THROTTLE_HEAL chaos events.
+
+    slowexec:<task_glob>=<factor>[:<start_s>[:<heal_after_s>]]
+        Execution-time stretch: a task whose name matches
+        ``task_glob`` (fnmatch) runs ``factor``x slower — the worker
+        sleeps (factor-1) x elapsed after user code returns. Models a
+        cpu-starved/thermally-throttled worker without touching user
+        code; epoch-windowed like throttle. First stretched task
+        records a SLOWEXEC chaos event.
+
     partition:<roleA><-><roleB>=<start_s>[:<heal_after_s>][?dir=both|a2b|b2a]
         Sustained link cut between two process roles: every PeerConn
         frame flowing a blocked direction is blackholed (the TCP
@@ -87,6 +109,9 @@ __all__ = [
     "kill_point",
     "fault_point",
     "partition_blocks",
+    "throttled",
+    "throttle_pace",
+    "slowexec_stretch",
     "mtype_of",
 ]
 
@@ -316,6 +341,55 @@ class _PartitionRule:
         )
 
 
+class _ThrottleRule:
+    """Token-bucket link degradation between two roles.
+
+    ``next_free`` is the virtual clock of the modeled slow link: the
+    epoch-relative instant its transmit queue drains. Each frame
+    advances it by size/rate; the caller sleeps until its own frame
+    has "transmitted". Pure function of (bytes seen, window), so a
+    seeded run replays byte-for-byte."""
+
+    __slots__ = (
+        "role_a", "role_b", "rate", "start_s", "heal_s", "direction",
+        "key", "began", "healed", "next_free",
+    )
+
+    def __init__(self, role_a, role_b, rate, start_s, heal_s, direction,
+                 key):
+        self.role_a = role_a
+        self.role_b = role_b
+        self.rate = float(rate)  # bytes per second
+        self.start_s = start_s
+        self.heal_s = heal_s  # absolute epoch offset (None = never)
+        self.direction = direction
+        self.key = key
+        self.began = False
+        self.healed = False
+        self.next_free = 0.0
+
+    def covers(self, src: str, dst: str) -> bool:
+        if self.direction in ("both", "a2b") and (
+            src == self.role_a and dst == self.role_b
+        ):
+            return True
+        return self.direction in ("both", "b2a") and (
+            src == self.role_b and dst == self.role_a
+        )
+
+
+class _SlowExecRule:
+    __slots__ = ("pattern", "factor", "start_s", "heal_s", "key", "began")
+
+    def __init__(self, pattern, factor, start_s, heal_s, key):
+        self.pattern = pattern
+        self.factor = float(factor)
+        self.start_s = start_s
+        self.heal_s = heal_s
+        self.key = key
+        self.began = False
+
+
 def current_role() -> str:
     """Coarse process role for rule scoping. Workers carry
     RAY_TPU_WORKER_ID from spawn; raylets set RAY_TPU_CHAOS_ROLE."""
@@ -347,6 +421,8 @@ class FaultSchedule:
         # dying (_KillRule is reused as the decision record).
         self._fault_rules: Dict[str, List[_KillRule]] = {}
         self._partition_rules: List[_PartitionRule] = []
+        self._throttle_rules: List[_ThrottleRule] = []
+        self._slowexec_rules: List[_SlowExecRule] = []
         # Shared time base for partition windows: every process in the
         # fleet must agree on when a cut begins/heals, so the epoch
         # rides the environment (the soak exports it before spawning
@@ -410,6 +486,44 @@ class FaultSchedule:
                     role_a.strip(), role_b.strip(), start_s, heal_s,
                     direction, key,
                 )
+            )
+            return
+        if name.startswith("throttle:"):
+            pair = name[len("throttle:"):]
+            if "<->" not in pair:
+                raise ValueError(
+                    f"throttle rule needs '<roleA><-><roleB>': {entry!r}"
+                )
+            role_a, role_b = pair.split("<->", 1)
+            parts = value.split(":")
+            rate = float(parts[0])
+            if rate <= 0:
+                raise ValueError(f"throttle rate must be > 0: {entry!r}")
+            start_s = float(parts[1]) if len(parts) > 1 else 0.0
+            heal_s = (
+                start_s + float(parts[2]) if len(parts) > 2 else None
+            )
+            self._throttle_rules.append(
+                _ThrottleRule(
+                    role_a.strip(), role_b.strip(), rate, start_s,
+                    heal_s, direction, key,
+                )
+            )
+            return
+        if name.startswith("slowexec:"):
+            pattern = name[len("slowexec:"):]
+            parts = value.split(":")
+            factor = float(parts[0])
+            if factor < 1.0:
+                raise ValueError(
+                    f"slowexec factor must be >= 1: {entry!r}"
+                )
+            start_s = float(parts[1]) if len(parts) > 1 else 0.0
+            heal_s = (
+                start_s + float(parts[2]) if len(parts) > 2 else None
+            )
+            self._slowexec_rules.append(
+                _SlowExecRule(pattern, factor, start_s, heal_s, key)
             )
             return
         if name.startswith("kill:"):
@@ -643,6 +757,127 @@ class FaultSchedule:
             blocked = True
         return blocked
 
+    # ------------------------------------------------------------- throttles
+
+    #: Per-frame pacing cap: an oversized frame on a starved link must
+    #: stall, not wedge the connection past every test deadline (the
+    #: heal window still bounds the total degradation).
+    _MAX_PACE_S = 30.0
+
+    def throttled(self, src_role: str, dst_role: str) -> bool:
+        """Cheap in-window check: True when a throttle rule currently
+        degrades ``src_role`` → ``dst_role`` traffic. Callers use it to
+        skip payload materialization on healthy links."""
+        if not self._throttle_rules:
+            return False
+        now = time.time() - self._epoch
+        for rule in self._throttle_rules:
+            if not rule.covers(src_role, dst_role):
+                continue
+            if now < rule.start_s:
+                continue
+            if rule.heal_s is not None and now >= rule.heal_s:
+                with self._lock:
+                    heal_edge = rule.began and not rule.healed
+                    rule.healed = True
+                if heal_edge:
+                    self.stats[f"throttle_heal:{rule.key}"] = 1
+                    if _events.enabled():
+                        _events.record(
+                            _events.CHAOS,
+                            f"{rule.role_a}<->{rule.role_b}",
+                            "THROTTLE_HEAL",
+                            {"rule": rule.key, "at_s": round(now, 3)},
+                        )
+                continue
+            return True
+        return False
+
+    def throttle_pace(self, src_role: str, dst_role: str,
+                      nbytes: int) -> float:
+        """Token-bucket pacing for one ``nbytes`` frame flowing
+        ``src_role`` → ``dst_role``: sleeps until the modeled slow link
+        would have transmitted it, returns the seconds slept. Both the
+        sender and the receiver boundary call this, so installing the
+        spec in only one side's processes still degrades both
+        directions of its links (mirrors partition enforcement). The
+        virtual clock never runs past the heal instant — a backlogged
+        bucket drains at heal instead of outliving it."""
+        if not self._throttle_rules:
+            return 0.0
+        delay = 0.0
+        edges = []
+        with self._lock:
+            now = time.time() - self._epoch
+            for rule in self._throttle_rules:
+                if not rule.covers(src_role, dst_role):
+                    continue
+                if now < rule.start_s:
+                    continue
+                if rule.heal_s is not None and now >= rule.heal_s:
+                    continue
+                if not rule.began:
+                    rule.began = True
+                    edges.append((rule, now))
+                start = max(now, rule.next_free)
+                free_at = start + nbytes / rule.rate
+                if rule.heal_s is not None:
+                    free_at = min(free_at, rule.heal_s)
+                rule.next_free = free_at
+                delay = max(delay, min(free_at - now, self._MAX_PACE_S))
+                k = f"throttle:{rule.key}"
+                self.stats[k] = self.stats.get(k, 0) + 1
+        for rule, at in edges:
+            if _events.enabled():
+                _events.record(
+                    _events.CHAOS,
+                    f"{rule.role_a}<->{rule.role_b}",
+                    "THROTTLE_BEGIN",
+                    {
+                        "rule": rule.key, "dir": rule.direction,
+                        "rate": rule.rate, "at_s": round(at, 3),
+                    },
+                )
+        if delay > 0:
+            time.sleep(delay)
+        return delay
+
+    # -------------------------------------------------------------- slowexec
+
+    def slowexec_factor(self, task_name: str) -> float:
+        """Current execution stretch factor for ``task_name`` (1.0 =
+        untouched). The worker multiplies wall time by this after user
+        code returns."""
+        if not self._slowexec_rules:
+            return 1.0
+        import fnmatch
+
+        now = time.time() - self._epoch
+        factor = 1.0
+        edges = []
+        for rule in self._slowexec_rules:
+            if now < rule.start_s:
+                continue
+            if rule.heal_s is not None and now >= rule.heal_s:
+                continue
+            if not fnmatch.fnmatch(task_name, rule.pattern):
+                continue
+            if rule.factor > factor:
+                factor = rule.factor
+            with self._lock:
+                if not rule.began:
+                    rule.began = True
+                    edges.append(rule)
+                k = f"slowexec:{rule.key}"
+                self.stats[k] = self.stats.get(k, 0) + 1
+        for rule in edges:
+            if _events.enabled():
+                _events.record(
+                    _events.CHAOS, rule.pattern, "SLOWEXEC",
+                    {"rule": rule.key, "factor": rule.factor},
+                )
+        return factor
+
     # ----------------------------------------------------------- connect hook
 
     def on_connect(self, address: str) -> None:
@@ -726,6 +961,50 @@ def partition_blocks(src_role: str, dst_role: str) -> bool:
     chaos is off)."""
     sched = _active
     return sched is not None and sched.partition_blocks(src_role, dst_role)
+
+
+def throttled(src_role: str, dst_role: str) -> bool:
+    """Transport hook: True when a throttle rule currently degrades
+    ``src_role`` → ``dst_role`` traffic (one module-global read when
+    chaos is off)."""
+    sched = _active
+    return sched is not None and sched.throttled(src_role, dst_role)
+
+
+def throttle_pace(src_role: str, dst_role: str, nbytes: int) -> float:
+    """Transport hook: pace one frame through the modeled slow link
+    (sleeps HERE, inside the chaos engine — transport dispatch paths
+    stay free of direct sleeps). Returns seconds slept."""
+    sched = _active
+    if sched is None:
+        return 0.0
+    return sched.throttle_pace(src_role, dst_role, nbytes)
+
+
+def slowexec_stretch(task_name: str, elapsed_s: float,
+                     cancelled=None) -> float:
+    """Worker execution hook: sleep the extra (factor-1) x elapsed a
+    degraded machine would have taken for this task. Returns seconds
+    slept (0.0 when chaos is off or no rule matches). ``cancelled``
+    (optional callable) is polled during the stretch: a hedge loser
+    whose twin already won stops stretching early — the straggling node
+    stays slow, but cancellation still frees its worker."""
+    sched = _active
+    if sched is None or elapsed_s <= 0:
+        return 0.0
+    factor = sched.slowexec_factor(task_name)
+    if factor <= 1.0:
+        return 0.0
+    extra = (factor - 1.0) * elapsed_s
+    if cancelled is None:
+        time.sleep(extra)
+        return extra
+    t0 = time.monotonic()
+    while True:
+        left = extra - (time.monotonic() - t0)
+        if left <= 0 or cancelled():
+            return time.monotonic() - t0
+        time.sleep(min(0.05, left))
 
 
 def mtype_of(msg: Any) -> Optional[str]:

@@ -1456,11 +1456,30 @@ class CoreClient:
             and not self.store.contains(oid)
         ):
             addr = reply.get("transfer_addr")
+
+            def _relead(slow_addr: str):
+                # Hedged pull: the current holder is below the
+                # throughput floor — ask the directory again and move to
+                # wherever the primary copy lives now. Same answer is
+                # fine too (the fetcher reconnected already); returning
+                # None just keeps the current lead.
+                try:
+                    fresh = self.request_reliable(
+                        {"type": "get_object", "object_id": oid.binary()}
+                    )
+                except (ConnectionLost, RayTpuError):
+                    return None
+                new_addr = fresh.get("transfer_addr")
+                if not new_addr or fresh.get("node_id") == self.node_id:
+                    return None
+                return new_addr
+
             # The caller's remaining get budget covers BOTH the
             # admission queue wait and the chunk fetch — a pull parked
             # behind a saturated budget must not fail a patient get.
             if not addr or not self._pull_manager.pull(
-                oid, addr, size=reply.get("size") or 0, timeout=timeout
+                oid, addr, size=reply.get("size") or 0, timeout=timeout,
+                resolve=_relead,
             ):
                 raise ObjectLostError(
                     f"object {oid.hex()} on node "
@@ -1860,7 +1879,13 @@ class CoreClient:
     # ------------------------------------------------------------------- misc
 
     def cluster_info(self) -> Dict[str, Any]:
-        return self.conn.request({"type": "cluster_info"})
+        # A state read, not a bare request: health signals recorded in
+        # this process's ring (a puller's PULL_RELEAD naming a slow
+        # provider) must reach the head's scorer no later than the poll
+        # that asks about node health — a bare request would leave a
+        # driver-observed straggler invisible until some unrelated
+        # state read happened to flush the ring.
+        return self.state_read({"type": "cluster_info"})
 
     def request(self, msg: Dict[str, Any], timeout: Optional[float] = None) -> Dict[str, Any]:
         # Failover-transparent: control-plane requests (kv, actor

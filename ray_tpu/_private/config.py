@@ -42,6 +42,41 @@ _DEFAULTS: Dict[str, Any] = {
     # Health checking (reference: gcs_health_check_manager.h).
     "health_check_period_ms": 1000,
     "health_check_failure_threshold": 5,
+    # Gray-failure tolerance (straggler layer). The scorer runs each
+    # health sweep: per-node EWMA over the sweep's good/bad signals
+    # (heartbeat inter-arrival jitter, lease-grant→ack transit, exec
+    # overrun vs recorded percentiles, pull re-leads). Thresholds have
+    # hysteresis built in: suspect below health_suspect_score,
+    # quarantine below health_quarantine_score only via sustained EWMA
+    # decay, readmission above health_readmit_score for
+    # health_readmit_windows CONSECUTIVE sweeps.
+    "health_score_alpha": 0.25,
+    "health_suspect_score": 0.6,
+    "health_quarantine_score": 0.35,
+    "health_readmit_score": 0.85,
+    "health_readmit_windows": 3,
+    # A heartbeat gap above jitter_factor x health_check_period counts
+    # as a bad signal; a grant→ack transit above grant_lat_s likewise.
+    "health_hb_jitter_factor": 3.0,
+    "health_grant_lat_s": 1.0,
+    # Speculative (hedged) execution: a task running on a suspect/
+    # quarantined node for longer than hedge_overrun_factor x its
+    # name's recorded p99 (needs >= hedge_min_samples completions) gets
+    # a duplicate lease on a healthy node; first done wins, the loser
+    # is cancelled. 0 disables hedging.
+    "hedge_overrun_factor": 3.0,
+    "hedge_min_samples": 8,
+    "hedge_max_inflight": 16,
+    # Hedged pulls: an active chunk pull whose measured throughput
+    # drops below the floor (bytes/s, after the grace window) aborts
+    # the attempt and re-leads onto a re-resolved holder without
+    # double-charging the in-flight byte budget. 0 disables.
+    "pull_relead_floor_bytes_s": 0,
+    "pull_relead_grace_s": 2.0,
+    # Testing hook: skip the same-host shm pull shortcut so every pull
+    # takes the chunked TCP path (the straggler soak throttles the
+    # data plane at the PeerConn boundary, which shm copies bypass).
+    "transfer_force_tcp": False,
     # Task scheduling.
     "max_pending_lease_requests_per_scheduling_class": 10,
     # Hybrid policy (reference: hybrid_scheduling_policy.h:29-49 +

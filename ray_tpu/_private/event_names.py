@@ -74,6 +74,10 @@ EVENTS_BY_CATEGORY = {
             "REF_FLUSH", "REF_REFLUSH", "SHARD_ENQUEUE", "SHARD_APPLY",
             "OWNER_FALLBACK", "SPILL_FAIL",
             "PULL_QUEUED", "PULL_ACTIVATE", "PULL_DONE", "PULL_CANCEL",
+            # Hedged pulls (straggler layer): an active pull whose
+            # throughput fell below the floor re-led onto another
+            # holder (the in-flight byte budget is charged once).
+            "PULL_RELEAD",
         }
     ),
     "chaos": frozenset(
@@ -83,6 +87,9 @@ EVENTS_BY_CATEGORY = {
             # Partition primitive: link-cut window edges (begin on the
             # first blocked frame, heal on the first frame after).
             "PARTITION_BEGIN", "PARTITION_HEAL",
+            # Sustained-degradation primitives: token-bucket link
+            # throttle window edges and the first stretched execution.
+            "THROTTLE_BEGIN", "THROTTLE_HEAL", "SLOWEXEC",
         }
     ),
     "head": frozenset(
@@ -95,6 +102,11 @@ EVENTS_BY_CATEGORY = {
             # rejected, and a zombie raylet draining itself after
             # learning it was declared dead.
             "NODE_FENCED", "ACTOR_EPOCH_FENCED", "ZOMBIE_SELF_FENCE",
+            # Gray-failure tolerance (straggler layer): per-sweep node
+            # score, suspect/quarantine/readmit transitions, and the
+            # speculative-execution hedge lifecycle.
+            "HEALTH_SCORE", "NODE_SUSPECT", "NODE_QUARANTINE",
+            "NODE_READMIT", "HEDGE_LAUNCH", "HEDGE_WIN", "HEDGE_CANCEL",
         }
     ),
 }

@@ -210,6 +210,34 @@ class NodeState:
     # heartbeats (the daemon's local dispatch authority).
     local_cpus_in_use: float = 0.0
     local_tpus_in_use: float = 0.0
+    # --- gray-failure health (scored by _score_nodes each sweep) ---
+    # EWMA in [0,1]; 1.0 = healthy. Derived from heartbeat
+    # inter-arrival jitter, lease-grant→ack transit, per-task exec
+    # overrun, and pull re-lead attribution. EWMA + the consecutive-
+    # window counters below give hysteresis: one blip never flips
+    # state, readmission needs sustained health.
+    health_score: float = 1.0
+    # Monotonic timestamp of the previous heartbeat (inter-arrival).
+    prev_heartbeat: float = 0.0
+    # Worst heartbeat inter-arrival gap and grant→ack transit observed
+    # since the last scoring sweep (reset each sweep).
+    hb_gap_max: float = 0.0
+    grant_lat_max: float = 0.0
+    # Pull re-leads attributed to this node's transfer server and exec
+    # overruns observed since the last sweep.
+    releads: int = 0
+    overruns: int = 0
+    # Quarantine (NOT the fence path): no new leases or pull leads;
+    # existing work finishes or hedges away; readmitted after
+    # readmit_windows consecutive healthy sweeps. Only true silence
+    # escalates to the PR 13 fence.
+    quarantined: bool = False
+    quarantined_at: float = 0.0
+    healthy_windows: int = 0
+    suspect: bool = False
+    # Hedge scoreboard (surfaced by list_cluster_nodes).
+    hedges_won: int = 0
+    hedges_lost: int = 0
 
 
 @dataclass
@@ -437,6 +465,28 @@ class GcsServer:
         # check; the re-sweep (one grace period later) retires anything
         # that slipped through the crack.
         self._dead_resweeps: deque = deque()
+        # --- gray-failure tolerance (straggler layer) ---
+        # Per-task-name recent execution durations (head-measured,
+        # dispatch→done), the percentile baseline the hedger compares
+        # running tasks against. Bounded per name and in names.
+        self._exec_durations: Dict[str, deque] = {}
+        # Speculative execution: task_id -> hedge entry
+        # {"seqs": {wid: seq-or-None}, "winner": wid-or-None,
+        #  "pending": set(wids)}. The primary dispatch predates the
+        # hedge so its expected seq is None; twins get 1, 2, ....
+        # Guarded by self._lock like every scheduler table.
+        self._hedges: Dict[bytes, Dict[str, Any]] = {}
+        # Hedge counters for Prometheus + list_cluster_nodes.
+        self._hedge_stats = {"launched": 0, "won": 0, "cancelled": 0}
+        self._quarantine_stats = {"quarantined": 0, "readmitted": 0}
+        # transfer_addr -> node_id for PULL_RELEAD attribution (a
+        # re-lead names the slow provider by its transfer address).
+        self._transfer_addr_nodes: Dict[str, bytes] = {}
+        # Prometheus gauges/counters, built lazily (first sweep).
+        self._straggler_gauges = None
+        # Scorer/metrics faults swallowed by the health sweep (counted,
+        # never silent).
+        self._scorer_errors = 0
         # Pick up a chaos/delay spec configured for this head (the
         # standalone head process path never runs worker.init's
         # refresh; redundant on the in-driver path, and cheap).
@@ -1071,6 +1121,7 @@ class GcsServer:
             w.conn.send({
                 "type": "execute_task", "spec": spec,
                 "actor_epoch": actor.epoch,
+                "t_grant": time.time(),
             })
             self._record_task_event(
                 spec.task_id.binary(), spec.name, "RUNNING",
@@ -1241,6 +1292,19 @@ class GcsServer:
                 if isinstance(wid, bytes)
                 else str(msg.get("source", "?"))
             )
+        for item in items or ():
+            # Health signal: a PULL_RELEAD names the slow provider by
+            # transfer address — charge the node it belongs to. One
+            # string compare per item on the ingest path; the indexer
+            # does the heavy lifting elsewhere.
+            if len(item) >= 6 and item[4] == "PULL_RELEAD":
+                attrs = item[5] or {}
+                nid = self._transfer_addr_nodes.get(attrs.get("addr", ""))
+                if nid is not None:
+                    with self._lock:
+                        node = self.nodes.get(nid)
+                        if node is not None:
+                            node.releads += 1
         self.events.ingest(items or [], source, dropped)
 
     def _h_event_batch(self, state, msg):
@@ -1309,7 +1373,20 @@ class GcsServer:
                             "task": task_id.hex()[:12],
                         },
                     )
+                # A hedged actor task's stale twin takes this fence
+                # path — drop its hedge bookkeeping so the entry
+                # doesn't outlive the race.
+                self._hedge_drop_reporter(task_id, wid)
                 return
+        if task_id in self._hedges and not self._hedge_adjudicate(
+            task_id, wid, w, msg
+        ):
+            # Speculative twin lost the race (or is a stale echo): its
+            # lease came home and its results must NOT seal — the
+            # winner's already did (or is about to, earlier in this
+            # same batch). Exactly-one-side-effect mirrors the actor
+            # epoch fence above.
+            return
         self.task_events.append(
             (
                 task_id,
@@ -1320,7 +1397,33 @@ class GcsServer:
             )
         )
         if w is not None:
+            node = self.nodes.get(w.node_id.binary())
+            if node is not None:
+                glat = msg.get("grant_lat")
+                if glat is not None and glat > node.grant_lat_max:
+                    # Health signal: worst lease-grant→receive transit
+                    # this sweep (echoed by the worker's push handler).
+                    node.grant_lat_max = float(glat)
             if w.state == W_BUSY:
+                if (
+                    w.task_started_at
+                    and spec is not None
+                    and error_blob is None
+                    and (
+                        node is None
+                        or not (node.suspect or node.quarantined)
+                    )
+                ):
+                    # Percentile baseline for the hedger: head-measured
+                    # dispatch→done durations per task name, bounded
+                    # both per-name and in names (hot names win slots).
+                    dq = self._exec_durations.get(spec.name)
+                    if dq is None and len(self._exec_durations) < 512:
+                        dq = self._exec_durations[spec.name] = deque(
+                            maxlen=256
+                        )
+                    if dq is not None:
+                        dq.append(time.time() - w.task_started_at)
                 w.state = (
                     W_ACTOR
                     if (w.actor_id is not None or w.packed)
@@ -1441,6 +1544,87 @@ class GcsServer:
         if msg.get("actor_creation"):
             self._on_actor_created(msg["actor_id"], wid, ok=error_blob is None,
                                    error_blob=error_blob)
+
+    def _hedge_drop_reporter(self, task_id: bytes, wid: bytes) -> None:
+        """Forget one twin's pending report; drops the entry once every
+        twin has reported (or died). Caller holds self._lock."""
+        hedge = self._hedges.get(task_id)
+        if hedge is None:
+            return
+        hedge["pending"].discard(wid)
+        if not hedge["pending"]:
+            del self._hedges[task_id]
+
+    def _hedge_adjudicate(self, task_id: bytes, wid: bytes, w,
+                          msg: Dict[str, Any]) -> bool:
+        """First-done-wins for a hedged task. Caller holds self._lock.
+
+        True → this record is the winner, apply it normally. False →
+        loser/stale twin: worker state and resources are restored HERE
+        (its lease comes home), results are discarded by the caller.
+        The hedge_seq echo fences the same way a stale actor epoch
+        does: a done whose (worker, seq) doesn't match what the head
+        dispatched can never seal, even if it's the first to arrive."""
+        hedge = self._hedges[task_id]
+        seq = msg.get("hedge_seq")
+        known = wid in hedge["seqs"]
+        authentic = known and seq == hedge["seqs"][wid]
+        if hedge["winner"] is None and authentic:
+            hedge["winner"] = wid
+            self._hedge_stats["won"] += 1
+            if w is not None:
+                node = self.nodes.get(w.node_id.binary())
+                if node is not None:
+                    node.hedges_won += 1
+            if _events.enabled():
+                _events.record(
+                    _events.HEAD, task_id.hex()[:12], "HEDGE_WIN",
+                    {"worker": wid.hex()[:12], "seq": seq},
+                )
+            # Cancel the twin(s) still running: Python can't preempt
+            # user code, but the mark makes their done skip value
+            # sealing (no pool bytes committed for rejected results).
+            for other in hedge["seqs"]:
+                if other == wid:
+                    continue
+                ow = self.workers.get(other)
+                if ow is not None and ow.conn is not None:
+                    try:
+                        ow.conn.send(
+                            {"type": "cancel_task", "task_id": task_id}
+                        )
+                    except ConnectionLost:
+                        pass
+            self._hedge_drop_reporter(task_id, wid)
+            return True
+        # Loser (winner already chosen) or stale echo (unknown worker /
+        # seq mismatch): restore the lease, reject the results.
+        self._hedge_stats["cancelled"] += 1
+        if w is not None:
+            node = self.nodes.get(w.node_id.binary())
+            if node is not None:
+                node.hedges_lost += 1
+            if w.state == W_BUSY:
+                w.state = (
+                    W_ACTOR
+                    if (w.actor_id is not None or w.packed)
+                    else W_IDLE
+                )
+                if w.current_task is not None:
+                    self._release_task_resources(
+                        w.current_task, w.node_id
+                    )
+                w.current_task = None
+        if _events.enabled():
+            _events.record(
+                _events.HEAD, task_id.hex()[:12], "HEDGE_CANCEL",
+                {
+                    "worker": wid.hex()[:12], "seq": seq,
+                    "stale": not authentic,
+                },
+            )
+        self._hedge_drop_reporter(task_id, wid)
+        return False
 
     def _on_actor_created(self, aid: bytes, wid: bytes, ok: bool, error_blob=None):
         actor = self.actors.get(aid)
@@ -2349,9 +2533,19 @@ class GcsServer:
                         "incarnation": n.incarnation,
                         "total": dict(n.total),
                         "available": dict(n.available),
+                        "health_score": round(n.health_score, 3),
+                        "quarantined": n.quarantined,
+                        "hedges_won": n.hedges_won,
+                        "hedges_lost": n.hedges_lost,
                     }
                 )
-        state["peer"].reply(msg, ok=True, total=total, available=avail, nodes=nodes)
+            stragglers = {
+                "hedges": dict(self._hedge_stats),
+                "quarantine": dict(self._quarantine_stats),
+                "scorer_errors": self._scorer_errors,
+            }
+        state["peer"].reply(msg, ok=True, total=total, available=avail,
+                            nodes=nodes, stragglers=stragglers)
 
     def _h_ping(self, state, msg):
         state["peer"].reply(msg, ok=True, ts=time.time())
@@ -2599,6 +2793,10 @@ class GcsServer:
                         "label": n.label,
                         "total": dict(n.total),
                         "available": dict(n.available),
+                        "health_score": round(n.health_score, 3),
+                        "quarantined": n.quarantined,
+                        "hedges_won": n.hedges_won,
+                        "hedges_lost": n.hedges_lost,
                     }
                     for n in self.nodes.values()
                 ] + list(self.dead_nodes)
@@ -2811,6 +3009,13 @@ class GcsServer:
                     if v < 0:  # acquired against the empty placeholder
                         node.available[k] = node.available.get(k, 0.0) + v
             self.nodes[node.node_id.binary()] = node
+            if node.transfer_addr:
+                # PULL_RELEAD attribution: a slow-pull re-lead names
+                # the provider by transfer address; map it back to the
+                # node so the scorer can charge the right machine.
+                self._transfer_addr_nodes[node.transfer_addr] = (
+                    node.node_id.binary()
+                )
             self._daemon_conn_count += 1
             state["role"] = "raylet"
             state["node_id"] = node.node_id.binary()
@@ -2901,7 +3106,17 @@ class GcsServer:
         with self._lock:
             node = self.nodes.get(msg["node_id"])
             if node is not None:
-                node.last_heartbeat = time.monotonic()
+                now_mono = time.monotonic()
+                if node.prev_heartbeat:
+                    # Health signal: worst inter-arrival gap since the
+                    # last scoring sweep (jitter, not just absence —
+                    # a throttled link stretches gaps long before the
+                    # death sweeper's threshold).
+                    gap = now_mono - node.prev_heartbeat
+                    if gap > node.hb_gap_max:
+                        node.hb_gap_max = gap
+                node.prev_heartbeat = now_mono
+                node.last_heartbeat = now_mono
                 # Periodic resource-view sync (reference: ray_syncer.h
                 # resource broadcasting): CPUs the daemon leased out
                 # locally come off this node's schedulable view,
@@ -3774,6 +3989,287 @@ class GcsServer:
                 self._finish_recovery()
             self._drain_ghosts()
             self._drain_promoted_graves()
+            # Gray-failure layer: score every live node from the
+            # sweep's signals, move the quarantine state machine, and
+            # launch hedges for tasks overrunning on suspect nodes.
+            try:
+                self._score_nodes(period)
+                self._launch_hedges()
+            except Exception:  # noqa: BLE001 - scorer must never
+                # take down the liveness sweep it rides on (counted,
+                # never silent).
+                self._scorer_errors += 1
+
+    def _score_nodes(self, period: float) -> None:
+        """Gray-failure scorer: fold the sweep's signals (heartbeat
+        inter-arrival jitter, lease-grant→ack transit, pull re-leads,
+        exec overruns) into each daemon node's health EWMA and move
+        the suspect/quarantine/readmit state machine. Quarantine is
+        probation, NOT the fence path: the node keeps heartbeating,
+        keeps its workers, and readmits after sustained health — only
+        true silence still reaches _handle_node_death."""
+        alpha = RayConfig.health_score_alpha
+        jitter_s = RayConfig.health_hb_jitter_factor * period
+        grant_cap = RayConfig.health_grant_lat_s
+        readmit_windows = RayConfig.health_readmit_windows
+        now_mono = time.monotonic()
+        with self._lock:
+            for node in self.nodes.values():
+                if not node.alive or node.conn is None:
+                    # The head's own node and virtual/driver nodes have
+                    # no heartbeat stream to score.
+                    continue
+                bad = 0
+                if node.hb_gap_max > jitter_s or (
+                    node.prev_heartbeat
+                    and now_mono - node.last_heartbeat > jitter_s
+                ):
+                    bad += 1
+                if node.grant_lat_max > grant_cap:
+                    bad += 1
+                if node.releads > 0:
+                    bad += 1
+                if node.overruns > 0:
+                    bad += 1
+                node.hb_gap_max = 0.0
+                node.grant_lat_max = 0.0
+                node.releads = 0
+                node.overruns = 0
+                sample = max(0.0, 1.0 - 0.5 * bad)
+                prev = node.health_score
+                score = (1.0 - alpha) * prev + alpha * sample
+                node.health_score = score
+                ent = node.node_id.hex()[:12]
+                if _events.enabled() and round(score, 2) != round(prev, 2):
+                    _events.record(
+                        _events.HEAD, ent, "HEALTH_SCORE",
+                        {"score": round(score, 3), "bad_signals": bad},
+                    )
+                was_suspect = node.suspect
+                node.suspect = score < RayConfig.health_suspect_score
+                if node.suspect and not was_suspect and _events.enabled():
+                    _events.record(
+                        _events.HEAD, ent, "NODE_SUSPECT",
+                        {"score": round(score, 3)},
+                    )
+                if (
+                    not node.quarantined
+                    and score < RayConfig.health_quarantine_score
+                ):
+                    # The EWMA alone is the hysteresis: one bad sweep
+                    # moves a healthy node to ~(1-alpha/2), nowhere
+                    # near this threshold — only sustained degradation
+                    # decays far enough.
+                    node.quarantined = True
+                    node.quarantined_at = time.time()
+                    node.healthy_windows = 0
+                    self._quarantine_stats["quarantined"] += 1
+                    if _events.enabled():
+                        _events.record(
+                            _events.HEAD, ent, "NODE_QUARANTINE",
+                            {"score": round(score, 3)},
+                        )
+                elif node.quarantined:
+                    if score >= RayConfig.health_readmit_score:
+                        node.healthy_windows += 1
+                        if node.healthy_windows >= readmit_windows:
+                            node.quarantined = False
+                            node.suspect = False
+                            node.healthy_windows = 0
+                            self._quarantine_stats["readmitted"] += 1
+                            if _events.enabled():
+                                _events.record(
+                                    _events.HEAD, ent, "NODE_READMIT",
+                                    {"score": round(score, 3)},
+                                )
+                            # Capacity returned: wake the scheduler.
+                            self._work.notify_all()
+                    else:
+                        # Readmission needs CONSECUTIVE healthy windows.
+                        node.healthy_windows = 0
+        self._update_straggler_metrics()
+
+    def _update_straggler_metrics(self) -> None:
+        """Prometheus surface for the straggler layer; built lazily,
+        disabled forever on the first failure (mirrors PullManager's
+        gauge pattern)."""
+        if self._straggler_gauges is False:
+            return
+        try:
+            if self._straggler_gauges is None:
+                from ..util.metrics import Counter, Gauge
+
+                self._straggler_gauges = {
+                    "score": Gauge(
+                        "ray_tpu_node_health_score",
+                        "Per-node gray-failure health score (1 = healthy)",
+                        tag_keys=("node_id",),
+                    ),
+                    "quarantined": Gauge(
+                        "ray_tpu_nodes_quarantined",
+                        "Nodes currently quarantined by the health scorer",
+                    ),
+                    "hedges": Counter(
+                        "ray_tpu_hedges_total",
+                        "Hedged (speculative) task executions by outcome",
+                        tag_keys=("outcome",),
+                    ),
+                    "transitions": Counter(
+                        "ray_tpu_quarantine_transitions_total",
+                        "Quarantine state transitions",
+                        tag_keys=("transition",),
+                    ),
+                    "_last": {},
+                }
+            g = self._straggler_gauges
+            last = g["_last"]
+            with self._lock:
+                rows = [
+                    (n.node_id.hex(), n.health_score, n.quarantined)
+                    for n in self.nodes.values()
+                    if n.alive and n.conn is not None
+                ]
+                counters = dict(self._hedge_stats)
+                counters.update(self._quarantine_stats)
+            nq = 0
+            for nid_hex, score, quarantined in rows:
+                g["score"].set(score, {"node_id": nid_hex[:12]})
+                nq += 1 if quarantined else 0
+            g["quarantined"].set(nq)
+            for key, metric, tag_key in (
+                ("launched", "hedges", "outcome"),
+                ("won", "hedges", "outcome"),
+                ("cancelled", "hedges", "outcome"),
+                ("quarantined", "transitions", "transition"),
+                ("readmitted", "transitions", "transition"),
+            ):
+                delta = counters[key] - last.get(key, 0)
+                if delta > 0:
+                    g[metric].inc(delta, {tag_key: key})
+                    last[key] = counters[key]
+        except Exception:  # noqa: BLE001 - metrics must never take
+            # down the health sweep (counted, never silent).
+            self._scorer_errors += 1
+            self._straggler_gauges = False
+
+    def _launch_hedges(self) -> None:
+        """Speculative execution: a GCS-routed plain task that has been
+        running on a suspect/quarantined node for longer than
+        hedge_overrun_factor x its name's recorded p99 gets a duplicate
+        lease on a healthy node. First task_done wins (hedge_seq
+        fencing in _apply_task_done); the loser is cancelled and its
+        results never seal. Actor tasks are never hedged from here —
+        duplicating actor-state mutations is exactly what the epoch
+        fence exists to prevent."""
+        k = RayConfig.hedge_overrun_factor
+        if not k:
+            return
+        min_samples = RayConfig.hedge_min_samples
+        now = time.time()
+        with self._lock:
+            budget = RayConfig.hedge_max_inflight - len(self._hedges)
+            for w in list(self.workers.values()):
+                if w.state != W_BUSY or w.current_task is None:
+                    continue
+                spec = w.current_task
+                if (
+                    spec.actor_id is not None
+                    or spec.actor_creation
+                    or spec.num_returns == -1  # streaming: items already
+                    # consumed can't be un-yielded by a losing twin
+                    or spec.placement_group_id is not None
+                    or spec.scheduling_strategy is not None
+                ):
+                    continue
+                node = self.nodes.get(w.node_id.binary())
+                if node is None:
+                    continue
+                tid = spec.task_id.binary()
+                dq = self._exec_durations.get(spec.name)
+                if dq is None or len(dq) < min_samples:
+                    continue
+                ordered = sorted(dq)
+                p99 = ordered[
+                    min(len(ordered) - 1, int(len(ordered) * 0.99))
+                ]
+                if now - w.task_started_at <= k * p99:
+                    continue
+                # The overrun is a scorer SIGNAL on any node (this is
+                # how slow execution alone makes a node suspect); the
+                # duplicate lease is dispatched only once the node has
+                # already decayed to suspect/quarantined — one genuine
+                # long task on a healthy node never hedges.
+                node.overruns += 1
+                if (
+                    budget <= 0
+                    or tid in self._hedges
+                    or not (node.suspect or node.quarantined)
+                ):
+                    continue
+                if self._dispatch_hedge(spec, w, node, now):
+                    budget -= 1
+
+    def _dispatch_hedge(self, spec, primary, primary_node,
+                        now: float) -> bool:
+        """Grant the duplicate lease on a healthy node with a warm idle
+        worker (hedges never spawn processes — a speculative copy is
+        not worth a cold interpreter boot). Caller holds self._lock."""
+        res = self._task_resources(spec)
+        candidates = [
+            n
+            for n in self.nodes.values()
+            if n.alive and n.schedulable and not n.quarantined
+            and not n.suspect
+            and n.node_id.binary() != primary_node.node_id.binary()
+            and _fits(n.available, res)
+        ]
+        tid = spec.task_id.binary()
+        for node in sorted(
+            candidates, key=lambda n: self._node_util(n, res)
+        ):
+            worker = self._pick_worker(node, spec)
+            if worker is None:
+                continue
+            _acquire(node.available, res)
+            worker.state = W_BUSY
+            worker.current_task = spec
+            worker.task_started_at = now
+            worker.inflight[tid] = spec
+            try:
+                worker.conn.send(
+                    {
+                        "type": "execute_task", "spec": spec,
+                        "hedge_seq": 1, "t_grant": time.time(),
+                    }
+                )
+            except ConnectionLost:
+                self._release_task_resources(spec, node.node_id)
+                worker.inflight.pop(tid, None)
+                worker.current_task = None
+                worker.state = W_IDLE
+                continue
+            self._hedges[tid] = {
+                # The primary's dispatch predates the hedge, so its
+                # done carries no hedge_seq (expected: None); the twin
+                # echoes 1. Anything else is a stale echo and fences.
+                "seqs": {primary.worker_id.binary(): None,
+                         worker.worker_id.binary(): 1},
+                "winner": None,
+                "pending": {primary.worker_id.binary(),
+                            worker.worker_id.binary()},
+            }
+            self._hedge_stats["launched"] += 1
+            if _events.enabled():
+                _events.record(
+                    _events.HEAD, tid.hex()[:12], "HEDGE_LAUNCH",
+                    {
+                        "name": spec.name,
+                        "from": primary_node.node_id.hex()[:12],
+                        "to": node.node_id.hex()[:12],
+                    },
+                )
+            return True
+        return False
 
     def _note_ghost(self, oid: bytes) -> None:
         """Caller holds the lock: watch an entry created by a question
@@ -4287,6 +4783,10 @@ class GcsServer:
                 node is not None
                 and node.alive
                 and node.schedulable
+                # Quarantined target: wait, don't fail — quarantine is
+                # probation, the node readmits when scores recover
+                # (the fence path below stays for truly-gone targets).
+                and not node.quarantined
                 and _fits(node.available, res)
             ):
                 _acquire(node.available, res)
@@ -4309,7 +4809,11 @@ class GcsServer:
         candidates = [
             n
             for n in self.nodes.values()
-            if n.alive and n.schedulable and _fits(n.available, res)
+            # Quarantine = drain, not fence: a sustained-bad-score node
+            # takes no NEW leases; existing work finishes or hedges
+            # away, and readmission restores it to this filter.
+            if n.alive and n.schedulable and not n.quarantined
+            and _fits(n.available, res)
         ]
         if not candidates:
             return None
@@ -4564,7 +5068,13 @@ class GcsServer:
             if spec.actor_creation:
                 worker.actor_id = spec.actor_id
         try:
-            msg_out = {"type": "execute_task", "spec": spec}
+            msg_out = {
+                "type": "execute_task", "spec": spec,
+                # Health signal: the worker echoes how long this grant
+                # spent in flight (grant_lat in the done record) — a
+                # throttled link stretches it 10-100x.
+                "t_grant": time.time(),
+            }
             if host_packed:
                 msg_out["packed"] = True
             worker.conn.send(msg_out)
@@ -4775,7 +5285,22 @@ class GcsServer:
                     _release(node.available, w.lease_resources)
                 w.lease_resources = None
             inflight, w.inflight = dict(w.inflight), {}
-            for spec in inflight.values():
+            for tid, spec in inflight.items():
+                hedge = self._hedges.get(tid)
+                if hedge is not None and wid in hedge["seqs"]:
+                    # A hedged twin died mid-race. It can't win
+                    # posthumously; if its sibling is still running
+                    # (or already won), the task needs NO retry — a
+                    # requeue here would re-run side effects the
+                    # sibling produces exactly once. Only when every
+                    # twin is gone does the normal retry path below
+                    # take over.
+                    del hedge["seqs"][wid]
+                    hedge["pending"].discard(wid)
+                    if not hedge["pending"]:
+                        self._hedges.pop(tid, None)
+                    if hedge["winner"] is not None or hedge["seqs"]:
+                        continue
                 if spec.actor_id is not None and not spec.actor_creation:
                     self._fail_task_returns(
                         spec, None, actor_error=f"actor worker died: {reason}"

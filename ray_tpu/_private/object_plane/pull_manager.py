@@ -209,7 +209,8 @@ class PullManager:
 
     def pull(self, oid, address: str, size: int = 0,
              priority: Optional[int] = None,
-             timeout: Optional[float] = 60.0) -> bool:
+             timeout: Optional[float] = 60.0,
+             resolve=None) -> bool:
         """Admission-gated fetch of ``oid`` from ``address`` into the
         local store. Blocks until the request activates (budget) and the
         underlying chunk pull finishes; False on cancellation, admission
@@ -219,7 +220,9 @@ class PullManager:
         budget is a transient, not a loss — and gives the fetch itself
         the fetcher's usual 60s window. ``size`` is the directory's
         sealed size — the budget charge (0 = unknown, charged as 1
-        byte)."""
+        byte). ``resolve`` (optional) re-leads a below-floor pull onto a
+        fresh holder inside the one admitted attempt — the budget is
+        charged once, never per re-lead (see ObjectFetcher.pull)."""
         key = oid.binary()
         deadline = None if timeout is None else time.monotonic() + timeout
         cls = current_pull_class() if priority is None else priority
@@ -266,6 +269,7 @@ class PullManager:
                     60.0 if deadline is None
                     else max(0.1, deadline - time.monotonic())
                 ),
+                resolve=resolve,
             )
         finally:
             self._release(key, leader, ok, rec)

@@ -18,6 +18,7 @@ from typing import Dict, Optional, Tuple
 from . import chaos as _chaos
 from . import events as _events
 from . import transport
+from .config import RayConfig
 from .ids import ObjectID
 from .object_store import ObjectStore
 from .protocol import ConnectionLost, PeerConn
@@ -27,6 +28,24 @@ CHUNK_BYTES = 4 << 20  # reference: object_manager_default_chunk_size (5 MiB)
 #: as a timeout this fast and the pull retries with backoff instead of
 #: burning the whole pull deadline waiting on one lost frame.
 ATTEMPT_TIMEOUT_S = 10.0
+
+#: Chaos role of the data plane: transfer-server conns (both ends) tag
+#: their peer with this so a `throttle:raylet<->transfer=...` rule slows
+#: chunk traffic without touching the control plane — a gray failure
+#: (heartbeats keep flowing), not a partition.
+TRANSFER_ROLE = "transfer"
+
+
+class SlowProviderError(Exception):
+    """One pull attempt measured below the hedged-pull throughput floor
+    (pull_relead_floor_bytes_s) past the grace window: the consumer
+    should re-lead onto a re-resolved holder instead of waiting out the
+    straggler."""
+
+    def __init__(self, size: int, bytes_per_s: float):
+        super().__init__(f"pull below floor: {bytes_per_s:.0f} B/s")
+        self.size = size
+        self.bytes_per_s = bytes_per_s
 
 
 def _host_id() -> str:
@@ -97,6 +116,7 @@ class ObjectTransferServer:
                 ),
             )
             holder["peer"] = peer
+            peer.peer_role = TRANSFER_ROLE
             self._peers.append(peer)
             peer.start()
 
@@ -228,6 +248,7 @@ class ObjectFetcher:
                 return peer
         raw = transport.connect(address, self._authkey)
         peer = PeerConn(raw, push_handler=lambda m: None, name="obj-fetch")
+        peer.peer_role = TRANSFER_ROLE
         with self._lock:
             existing = self._conns.get(address)
             if existing is not None and not existing.closed:
@@ -243,7 +264,8 @@ class ObjectFetcher:
         if peer is not None:
             peer.close()
 
-    def pull(self, oid: ObjectID, address: str, timeout: Optional[float] = 60.0) -> bool:
+    def pull(self, oid: ObjectID, address: str, timeout: Optional[float] = 60.0,
+             resolve=None) -> bool:
         """Fetch the object from `address` into the local store.
 
         Transient failures (lost/timed-out chunk request, dropped conn)
@@ -251,6 +273,12 @@ class ObjectFetcher:
         (reference: PullManager retries pulls on a timer,
         pull_manager.h); a definitive "object not found" fails fast so
         directory re-lookup/reconstruction can run instead.
+
+        ``resolve``, when given, is called with the current (slow)
+        provider address after an attempt falls below the hedged-pull
+        throughput floor; it returns a fresh address to re-lead onto
+        (or None to stay). The re-lead happens INSIDE this one call, so
+        an admission-controlled caller charges its byte budget once.
 
         Returns True when the object is locally readable afterwards."""
         key = oid.binary()
@@ -272,6 +300,11 @@ class ObjectFetcher:
             deadline = time.monotonic() + (timeout or 60.0)
             backoff = _chaos.Backoff(base_s=0.05, cap_s=2.0)
             ok, size, attempts = False, 0, 0
+            # Providers already flagged slow: when the re-lead resolves
+            # back to the same (sole) holder, the next attempt runs
+            # with the floor DISABLED — a slow pull beats a livelock of
+            # aborted attempts.
+            slow_addrs: set = set()
             while True:
                 attempts += 1
                 remaining = deadline - time.monotonic()
@@ -284,8 +317,30 @@ class ObjectFetcher:
                     if ok:
                         break
                     ok, size, transient = self._pull_chunks(
-                        oid, address, min(remaining, ATTEMPT_TIMEOUT_S)
+                        oid, address, min(remaining, ATTEMPT_TIMEOUT_S),
+                        floor_enabled=address not in slow_addrs,
                     )
+                except SlowProviderError as slow:
+                    slow_addrs.add(address)
+                    # Hedged pull: this holder is a straggler, not dead.
+                    # Re-lead onto a re-resolved holder immediately (no
+                    # backoff — the bytes so far were arriving, just too
+                    # slowly to wait out).
+                    self._drop_conn(address)
+                    if _rec.enabled:
+                        _rec.record(
+                            _events.REFS, oid.hex(), "PULL_RELEAD",
+                            {
+                                "addr": address,
+                                "bytes_s": round(slow.bytes_per_s),
+                                "attempt": attempts,
+                            },
+                        )
+                    if resolve is not None:
+                        fresh = resolve(address)
+                        if fresh:
+                            address = fresh
+                    continue
                 except (ConnectionLost, OSError):
                     ok, size, transient = False, 0, True
                 if ok or not transient:
@@ -328,6 +383,11 @@ class ObjectFetcher:
         chunked TCP pull. Never raises."""
         import concurrent.futures
 
+        if RayConfig.transfer_force_tcp:
+            # Testing hook: the straggler soak throttles the chunked
+            # data plane at the PeerConn boundary; the shm shortcut
+            # moves zero socket bytes and would bypass it.
+            return False, 0
         known = self._peer_hosts.get(address)
         me = _host_id()
         if known is not None and known != me:
@@ -394,14 +454,22 @@ class ObjectFetcher:
         return True, size
 
     def _pull_chunks(
-        self, oid: ObjectID, address: str, timeout
+        self, oid: ObjectID, address: str, timeout, floor_enabled: bool = True
     ) -> Tuple[bool, int, bool]:
         """One pull attempt. Returns (locally readable, size,
         transient) — transient=True means a retry may succeed (timeout,
-        lost conn); False is definitive (object not found)."""
+        lost conn); False is definitive (object not found). Raises
+        SlowProviderError when ``floor_enabled`` and measured
+        throughput stays under pull_relead_floor_bytes_s past the
+        grace window."""
         import concurrent.futures
 
         peer = self._conn_for(address)
+        # The attempt clock starts BEFORE the first chunk request: on a
+        # starved link the first chunk is where the pacing time goes,
+        # and anchoring after it would let a two-chunk object finish
+        # the loop inside the grace window without ever measuring.
+        t_attempt = time.monotonic()
         try:
             first = peer.request(
                 {"type": "pull_chunk", "object_id": oid.binary(), "offset": 0},
@@ -416,11 +484,22 @@ class ObjectFetcher:
         if view is None:
             # Local store can't hold it (exists already counts as success).
             return self._store.contains(oid), size, False
+        floor = RayConfig.pull_relead_floor_bytes_s if floor_enabled else 0
+        grace = RayConfig.pull_relead_grace_s
         try:
             data = first["data"]
             view[: len(data)] = data
             offset = len(data)
             while offset < size:
+                elapsed = time.monotonic() - t_attempt
+                if floor and elapsed > grace:
+                    rate = offset / elapsed
+                    if rate < floor:
+                        # Straggling provider: abandon this attempt's
+                        # partial bytes (reclaimed) and let the caller
+                        # re-lead onto another holder.
+                        self._store.abort_raw(oid)
+                        raise SlowProviderError(size, rate)
                 # Chaos: "kill node mid-pull" — a consumer dying with a
                 # half-written unsealed replica (the abort path must
                 # reclaim it, and the producer side must shrug).

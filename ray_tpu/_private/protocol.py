@@ -147,6 +147,23 @@ class PeerConn:
                 return
         msg = out[0] if len(out) == 1 else ("B", out)
         try:
+            if self.peer_role is not None and _chaos.throttled(
+                _chaos.current_role(), self.peer_role
+            ):
+                # Throttled link (chaos): materialize the frame to
+                # learn its wire size, pace it through the modeled
+                # slow link (the sleep lives in the chaos engine),
+                # then ship the bytes we already encoded. The receive
+                # boundary paces too, so a one-sided install still
+                # degrades both directions.
+                payload = _fp.encode(msg) if _fp is not None else None
+                if payload is None:
+                    payload = pickle.dumps(msg)
+                _chaos.throttle_pace(
+                    _chaos.current_role(), self.peer_role, len(payload)
+                )
+                self._conn.send_bytes(payload)
+                return
             if _fp is not None:
                 payload = _fp.encode(msg)
                 if payload is not None:
@@ -309,6 +326,17 @@ class PeerConn:
                     return  # finally below runs the close bookkeeping
             while True:
                 buf = recv_bytes()
+                if self.peer_role is not None and _chaos.throttled(
+                    self.peer_role, _chaos.current_role()
+                ):
+                    # Receive-side token bucket: pace inbound frames by
+                    # wire size before delivery (head-of-line blocking,
+                    # exactly what a saturated NIC does). The sleep
+                    # lives inside the chaos engine — this reader is
+                    # not a raylint dispatch root, _deliver is.
+                    _chaos.throttle_pace(
+                        self.peer_role, _chaos.current_role(), len(buf)
+                    )
                 if buf and buf[0] == _FAST_MAGIC and decode is not None:
                     msg = decode(buf)
                 else:

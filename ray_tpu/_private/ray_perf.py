@@ -557,6 +557,45 @@ PARTITION_SMOKE = {
 }
 
 
+# Straggler soak (gray-failure tolerance, ISSUE 20): one victim node
+# goes GRAY — alive, heartbeating, registering — but its task
+# execution is stretched 50x (slowexec) and later its data plane is
+# throttled to a trickle. Asserts the health scorer suspects then
+# quarantines it, that hedged twins keep task p99 within bound_factor
+# x the all-healthy baseline, that throttled multi-chunk pulls re-lead
+# (PULL_RELEAD) instead of wedging, that every hedged pair resolves to
+# exactly one accepted done (resource ledger never over-credits), that
+# the victim is readmitted after the fault heals, and that the whole
+# sequence composes with one supervised-head SIGKILL. Windows are
+# anchored to a shared epoch exported just before the victim boots;
+# t1 = slowexec start, t2 = throttle start, t3 = heal-all.
+# quarantine_score sits relative to the single-signal EWMA floor (a
+# node whose ONLY symptom is exec overruns converges to exactly 0.5 at
+# alpha 0.5). The full soak puts quarantine BELOW the floor: sustained
+# slowness alone keeps the victim suspect — hedging runs the whole
+# window, which is what accumulates >=100 pairs — and only the throttle
+# phase's pull re-leads landing in the same sweeps push the EWMA to
+# 0.25 and quarantine. The smoke's windows are too short for that
+# two-signal dance to be deterministic, so it puts quarantine ABOVE the
+# floor and lets sustained slowness alone quarantine.
+STRAGGLER_FULL = {
+    "nodes": 4, "victim_cpus": 6, "seed": 0x57A66, "task_s": 3.0,
+    "slow_factor": 50.0, "throttle_bytes_s": 1 << 20,
+    "blob_bytes": 6 << 20, "n_blobs": 4, "inflight": 8,
+    "t1": 15.0, "t2": 315.0, "t3": 335.0, "min_pairs": 100,
+    "quarantine_score": 0.45, "readmit_score": 0.8,
+    "bound_factor": 3.0, "get_timeout_s": 180.0, "head_kills": 1,
+}
+STRAGGLER_SMOKE = {
+    "nodes": 2, "victim_cpus": 4, "seed": 0x57A66, "task_s": 3.0,
+    "slow_factor": 50.0, "throttle_bytes_s": 1 << 20,
+    "blob_bytes": 6 << 20, "n_blobs": 3, "inflight": 3,
+    "t1": 15.0, "t2": 45.0, "t3": 60.0, "min_pairs": 3,
+    "quarantine_score": 0.55, "readmit_score": 0.8,
+    "bound_factor": 3.0, "get_timeout_s": 120.0, "head_kills": 1,
+}
+
+
 @ray_tpu.remote(num_cpus=1)
 def _envelope_fetch(x):
     """Broadcast consumer: materializing the arg IS the transfer."""
@@ -1836,6 +1875,509 @@ def bench_partition_soak(cfg: Dict[str, float]):
         shutil.rmtree(session_dir, ignore_errors=True)
 
 
+@ray_tpu.remote(num_cpus=1)
+def _straggler_unit(task_s: float, i: int):
+    """Unit of hedgeable work: sleeps, returns a per-EXECUTION token —
+    two executions of the same logical task produce different tokens,
+    so the one value a get observes identifies which twin's done the
+    head accepted. Name matches the soak's slowexec glob."""
+    import secrets as _secrets
+
+    time.sleep(task_s)
+    return (_secrets.token_hex(8), i)
+
+
+@ray_tpu.remote(num_cpus=1, resources={"victim": 1})
+def _straggler_blob(nbytes: int, i: int):
+    """Seal a multi-chunk object on the victim node; the driver pulls
+    it later, under the data-plane throttle, to exercise hedged pulls
+    (name does NOT match the slowexec glob)."""
+    return np.full(max(1, nbytes // 8), float(i), dtype=np.float64)
+
+
+@ray_tpu.remote(num_cpus=1, resources={"victim": 1})
+def _straggler_probe(x):
+    """Runs ON the victim: proves a blob is sealed there without the
+    driver pulling its bytes early (an early get would cache the value
+    driver-side and the throttled phase would have nothing to pull)."""
+    return int(getattr(x, "nbytes", 0))
+
+
+def bench_straggler_soak(cfg: Dict[str, float]):
+    """Seeded gray-failure soak (acceptance: ISSUE 20): a victim node
+    stays alive and heartbeating while its task execution is stretched
+    (slowexec) and then its transfer plane throttled — asserting (a)
+    the head's health scorer marks it suspect and then quarantines it
+    (drain, not fence), (b) hedged twins on healthy nodes keep task
+    p99 within bound_factor x the all-healthy baseline, (c) every
+    hedged pair resolves to exactly one accepted done and the resource
+    ledger never over-credits (a double-accepted done would double-
+    release the loser's lease), (d) throttled multi-chunk pulls
+    re-lead instead of wedging and still deliver correct bytes, (e)
+    hedging stays <= 1% launch rate while the cluster is healthy, (f)
+    the victim is readmitted after heal, and (g) the sequence composes
+    with a supervised-head SIGKILL. Deterministic per seed."""
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from ray_tpu.cluster_utils import DaemonCluster, SupervisedHead
+    from ray_tpu._private import chaos as _chaos
+    from ray_tpu._private.state import list_cluster_events
+    from ray_tpu._private.worker import global_client
+    from ray_tpu.exceptions import GetTimeoutError
+
+    seed = int(cfg["seed"])
+    task_s = float(cfg["task_s"])
+    t1, t2, t3 = float(cfg["t1"]), float(cfg["t2"]), float(cfg["t3"])
+    rate = int(cfg["throttle_bytes_s"])
+    get_timeout = float(cfg["get_timeout_s"])
+    n_blobs = int(cfg["n_blobs"])
+    min_pairs = int(cfg["min_pairs"])
+    bound = float(cfg["bound_factor"])
+    spec = (
+        f"slowexec:*straggler_unit*={cfg['slow_factor']:g}"
+        f":{t1:g}:{t3 - t1:g},"
+        f"throttle:raylet<->transfer={rate}:{t2:g}:{t3 - t2:g}"
+    )
+    print(
+        f"straggler_soak: seed={seed} (reproduce with "
+        f"--only straggler_soak --chaos-seed {seed})"
+    )
+    print(f"straggler_soak: victim spec={spec}")
+
+    # External head: the composability leg SIGKILLs it at the end. The
+    # scorer knobs are soak-tuned via the head's env — the defaults
+    # react on production-sized windows; the soak compresses fault
+    # windows to tens of seconds, so suspicion must follow within a
+    # couple of 1s sweeps (alpha 0.5: one bad sweep crosses 0.8).
+    head_env = {
+        "RAY_TPU_health_score_alpha": "0.5",
+        "RAY_TPU_health_suspect_score": "0.8",
+        # Where quarantine sits relative to the single-signal EWMA
+        # floor (0.5) decides whether sustained slowness alone
+        # quarantines (smoke) or whether it takes the throttle phase's
+        # second signal (full) — see the STRAGGLER_* comment.
+        "RAY_TPU_health_quarantine_score": f"{cfg['quarantine_score']:g}",
+        "RAY_TPU_health_readmit_score": f"{cfg['readmit_score']:g}",
+        "RAY_TPU_hedge_overrun_factor": "1.3",
+    }
+    ray_tpu.shutdown()
+    session_dir = tempfile.mkdtemp(prefix="rtpu_straggler_")
+    try:
+        head = SupervisedHead(session_dir=session_dir, env=head_env)
+    except (RuntimeError, TimeoutError, OSError) as e:
+        RESULTS["straggler_soak_skipped"] = 1.0  # counted, never silent
+        print(f"straggler_soak: SKIPPED — cannot launch external head: {e}")
+        return
+    cluster = None
+    stop = threading.Event()
+    stats = {"ok": 0, "failed": 0, "actor_ok": 0, "blob_ok": 0}
+    soak_errors = {"monitor": 0, "final_wave": 0, "teardown": 0,
+                   "nodes_poll": 0}
+    wedged: List[str] = []
+    problems: List[str] = []
+    ledger_violations: List[str] = []
+    bumps: List[tuple] = []
+    completed: List[tuple] = []  # (submit_s_rel_epoch, latency_s, token)
+    try:
+        # The driver is the puller for the blob leg: its pull floor and
+        # the TCP-only data plane (the same-host shm shortcut moves
+        # zero socket bytes, which the throttle could never see) are
+        # driver-side config.
+        ray_tpu.init(
+            address=head.address,
+            _system_config={
+                "transfer_force_tcp": True,
+                "pull_relead_floor_bytes_s": 2 * rate,
+                "pull_relead_grace_s": 1.0,
+                # The composability leg SIGKILLs the head while this
+                # driver is idle; a restart that takes longer than the
+                # default 15s budget would strand the final wave.
+                "gcs_reconnect_budget_s": 60.0,
+            },
+        )
+        client = global_client()
+        cluster = DaemonCluster.attach(head.tcp_address, head.authkey)
+        for i in range(int(cfg["nodes"])):
+            cluster.add_node(num_cpus=2, label=f"sg{i}")
+        # Shared fault clock: exported ONLY to the victim daemon (its
+        # workers inherit it), anchored right before boot.
+        epoch = time.time()
+        cluster.add_node(
+            num_cpus=int(cfg["victim_cpus"]),
+            resources={"victim": 8.0},
+            label="victim",
+            env={
+                "RAY_TPU_chaos_spec": spec,
+                "RAY_TPU_chaos_seed": str(seed),
+                "RAY_TPU_chaos_epoch": str(epoch),
+            },
+        )
+
+        def rel() -> float:
+            return time.time() - epoch
+
+        def victim_row():
+            try:
+                for n in ray_tpu.nodes():
+                    if n["label"] == "victim":
+                        return n
+            except Exception:  # noqa: BLE001 - mid-failover
+                soak_errors["nodes_poll"] += 1
+            return None
+
+        # Victim-held state: the epoch-stamped counter actor (its calls
+        # must keep flowing while the node is quarantined — quarantine
+        # drains NEW leases, it does not fence) and multi-chunk blobs
+        # sealed in the victim's segment for the hedged-pull leg.
+        counter = _EpochCounter.options(
+            name="straggler_counter", lifetime="detached"
+        ).remote()
+        tok0, _ = ray_tpu.get(counter.bump.remote(), timeout=60)
+        bumps.append((tok0, 1))
+        blob_refs = [
+            _straggler_blob.remote(int(cfg["blob_bytes"]), i)
+            for i in range(n_blobs)
+        ]
+
+        def traffic(idx: int):
+            lrng = random.Random(seed ^ (idx + 1))
+            bo = _chaos.Backoff(base_s=0.2, cap_s=1.5, rng=lrng)
+            while not stop.is_set():
+                t_sub = time.time()
+                try:
+                    ref = _straggler_unit.remote(task_s, idx)
+                    tok, _ = ray_tpu.get(ref, timeout=get_timeout)
+                    completed.append((t_sub - epoch,
+                                      time.time() - t_sub, tok))
+                    stats["ok"] += 1
+                    bo.reset()
+                    del ref
+                except GetTimeoutError as e:
+                    wedged.append(f"traffic[{idx}]: {e}")
+                    return
+                except Exception:  # noqa: BLE001 - failover window
+                    stats["failed"] += 1
+                    bo.sleep()
+
+        def actor_loop():
+            bo = _chaos.Backoff(
+                base_s=0.3, cap_s=2.0, rng=random.Random(seed)
+            )
+            while not stop.is_set():
+                ref = None
+                try:
+                    ref = counter.bump.remote()
+                    tok, n = ray_tpu.get(ref, timeout=get_timeout)
+                    bumps.append((tok, n))
+                    stats["actor_ok"] += 1
+                    bo.reset()
+                    time.sleep(0.5)
+                except GetTimeoutError as e:
+                    wedged.append(f"actor: {e}")
+                    return
+                except Exception:  # noqa: BLE001 - restart window
+                    stats["failed"] += 1
+                    bo.sleep()
+
+        def ledger_monitor():
+            # A double-accepted hedge done would release the loser's
+            # lease twice: per-node availability would exceed capacity.
+            while not stop.is_set():
+                try:
+                    info = client.cluster_info()
+                    for res, avail in info["available"].items():
+                        total = info["total"].get(res, 0.0)
+                        if avail > total + 1e-6:
+                            ledger_violations.append(
+                                f"{res}: available {avail} > total {total}"
+                            )
+                            return
+                except Exception:  # noqa: BLE001 - mid-failover
+                    soak_errors["monitor"] += 1
+                time.sleep(1.0)
+
+        def blob_get(i: int):
+            # Staggered starts spread the PULL_RELEAD signals over
+            # distinct scorer sweeps — tight enough that every pull
+            # begins (and trips its floor) before the heal instant.
+            target = t2 + 3.0 * i
+            while rel() < target and not stop.is_set():
+                time.sleep(0.25)
+            try:
+                arr = ray_tpu.get(blob_refs[i], timeout=get_timeout)
+                assert float(arr[0]) == float(i) and float(
+                    arr[-1]
+                ) == float(i), "re-led pull returned wrong bytes"
+                stats["blob_ok"] += 1
+            except GetTimeoutError as e:
+                wedged.append(f"blob[{i}]: {e}")
+
+        threads = [
+            threading.Thread(target=traffic, args=(i,), daemon=True)
+            for i in range(int(cfg["inflight"]))
+        ] + [
+            threading.Thread(target=actor_loop, daemon=True),
+            threading.Thread(target=ledger_monitor, daemon=True),
+        ] + [
+            threading.Thread(target=blob_get, args=(i,), daemon=True)
+            for i in range(n_blobs)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+
+        # Blob sealing overlaps baseline traffic (the probes run ON the
+        # victim, so the driver pulls nothing early); everything must
+        # be sealed well before the throttle window opens at t2.
+        sealed = ray_tpu.get(
+            [_straggler_probe.remote(r) for r in blob_refs], timeout=60
+        )
+        assert all(s > 0 for s in sealed)
+
+        def await_(pred, deadline_s, what) -> bool:
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline and not wedged:
+                if pred():
+                    return True
+                time.sleep(0.5)
+            problems.append(f"timeout: {what}")
+            return False
+
+        # Phase A — all-healthy baseline: warms the head's exec-p99
+        # window and pins down the hedge launch rate with no fault
+        # active (acceptance: <= 1%).
+        while rel() < t1 and not wedged:
+            time.sleep(0.25)
+        base_tasks = stats["ok"]
+        try:
+            base_launched = client.cluster_info()["stragglers"][
+                "hedges"]["launched"]
+        except Exception:  # noqa: BLE001
+            soak_errors["monitor"] += 1
+            base_launched = 0
+        print(
+            f"straggler_soak: baseline done at +{rel():.1f}s "
+            f"({base_tasks} tasks, {base_launched} hedges launched)"
+        )
+
+        # Phase B — slowexec [t1,t3) makes the victim a straggler;
+        # the throttle joins at t2 and the blob pulls start re-leading,
+        # giving the scorer its second signal: quarantine.
+        def quarantined():
+            row = victim_row()
+            return row is not None and row.get("quarantined")
+
+        saw_quarantine = await_(
+            quarantined, (t3 - rel()) + 30,
+            "victim never quarantined under slowexec+throttle",
+        )
+        quarantine_s = rel() if saw_quarantine else -1.0
+        if saw_quarantine:
+            print(f"straggler_soak: victim quarantined at +{rel():.1f}s")
+
+        # Phase C — heal at t3, then readmission: the score must climb
+        # back over the readmit threshold for N consecutive windows.
+        def readmitted():
+            if rel() < t3:
+                return False
+            row = victim_row()
+            return (row is not None and not row.get("quarantined")
+                    and row.get("health_score", 0.0) >= 0.85)
+
+        saw_readmit = saw_quarantine and await_(
+            readmitted, (t3 - rel()) + 90,
+            "victim never readmitted after heal",
+        )
+        readmit_s = rel() if saw_readmit else -1.0
+        if saw_readmit:
+            row = victim_row()
+            print(
+                f"straggler_soak: victim readmitted at +{rel():.1f}s "
+                f"(score={row['health_score'] if row else '?'})"
+            )
+
+        # Let the tail drain, then stop traffic.
+        tail = time.monotonic() + 5.0
+        while time.monotonic() < tail and not wedged:
+            time.sleep(0.25)
+        stop.set()
+        for t in threads:
+            t.join(timeout=get_timeout + 60)
+            if t.is_alive():
+                wedged.append(f"{t.name} did not finish after stop")
+        soak_s = time.perf_counter() - t0
+
+        # Stats + flight-recorder checks BEFORE the head kill (neither
+        # the hedge counters nor the recorder survive a head restart).
+        stragglers = {}
+        try:
+            stragglers = client.cluster_info().get("stragglers", {})
+        except Exception:  # noqa: BLE001
+            soak_errors["monitor"] += 1
+        hedges = stragglers.get("hedges", {})
+        straggler_events: set = set()
+
+        def events_visible():
+            for e in list_cluster_events(category="head", limit=10_000):
+                straggler_events.add(e["event"])
+            return {"NODE_SUSPECT", "NODE_QUARANTINE", "NODE_READMIT",
+                    "HEDGE_LAUNCH", "HEDGE_WIN"} <= straggler_events
+        await_(events_visible, 30,
+               "straggler flight-recorder events never surfaced")
+        releads = len([
+            e for e in list_cluster_events(category="refs", limit=10_000)
+            if e["event"] == "PULL_RELEAD"
+        ])
+
+        # Composability leg — SIGKILL the head after the fleet healed;
+        # a fresh scorer must come up and traffic must reconverge.
+        kills = 0
+        if int(cfg["head_kills"]) > 0:
+            restarts_before = head.restarts
+            head.kill()
+            kills = 1
+            print("straggler_soak: killed head (composability leg)")
+            if not head.wait_restarted(restarts_before + 1, timeout=60):
+                wedged.append("head never restarted")
+        final_ok = 0
+        for i in range(6):
+            try:
+                tok, _ = ray_tpu.get(
+                    _straggler_unit.remote(0.1, 10_000 + i), timeout=90
+                )
+                final_ok += 1
+            except Exception:  # noqa: BLE001
+                soak_errors["final_wave"] += 1
+
+        # ---------------------------------------------------- assertions
+        base_lats = sorted(lat for sub, lat, _ in completed if sub < t1)
+        slow_lats = sorted(
+            lat for sub, lat, _ in completed if t1 <= sub < t3
+        )
+
+        def p99(lats):
+            return lats[min(len(lats) - 1, int(len(lats) * 0.99))]
+
+        # Exactly-one-done bookkeeping: every adjudicated pair has one
+        # winner; tokens are per-execution, so a duplicate accept for
+        # the same logical task cannot hide behind equal values.
+        pairs_won = int(hedges.get("won", 0))
+        launched = int(hedges.get("launched", 0))
+        cancelled = int(hedges.get("cancelled", 0))
+        tokens = [tok for _, _, tok in completed]
+        hedge_rate_baseline = base_launched / max(1, base_tasks)
+
+        # Interleave check mirrors the partition soak: once a new actor
+        # incarnation answers, the old one must never answer again.
+        tokens_in_order: List[str] = []
+        interleaved = False
+        monotonic_ok = True
+        last_n: Dict[str, int] = {}
+        for tok, n in bumps:
+            if tok not in tokens_in_order:
+                tokens_in_order.append(tok)
+            elif tok != tokens_in_order[-1]:
+                interleaved = True
+            if n <= last_n.get(tok, 0):
+                monotonic_ok = False
+            last_n[tok] = n
+
+        RESULTS["straggler_soak_seconds"] = round(soak_s, 1)
+        RESULTS["straggler_pairs"] = pairs_won
+        RESULTS["straggler_hedge_rate_baseline"] = round(
+            hedge_rate_baseline, 4
+        )
+        RESULTS["straggler_releads"] = releads
+        if base_lats:
+            RESULTS["straggler_baseline_p99_s"] = round(p99(base_lats), 2)
+        if slow_lats:
+            RESULTS["straggler_window_p99_s"] = round(p99(slow_lats), 2)
+        if base_lats and slow_lats:
+            RESULTS["straggler_p99_ratio"] = round(
+                p99(slow_lats) / p99(base_lats), 2
+            )
+        RESULTS["straggler_quarantine_s"] = round(quarantine_s, 1)
+        RESULTS["straggler_readmit_s"] = round(readmit_s, 1)
+        print(
+            f"straggler_soak: {soak_s:.0f}s, tasks ok={stats['ok']} "
+            f"failed={stats['failed']} actor={stats['actor_ok']} "
+            f"blobs={stats['blob_ok']}/{n_blobs}, hedges "
+            f"launched={launched} won={pairs_won} cancelled={cancelled}, "
+            f"releads={releads}, head kills={kills}, "
+            f"events={sorted(straggler_events & {'NODE_SUSPECT', 'NODE_QUARANTINE', 'NODE_READMIT', 'HEDGE_LAUNCH', 'HEDGE_WIN', 'HEDGE_CANCEL'})}"
+        )
+        if wedged:
+            problems.append(f"wedged futures: {wedged}")
+        if ledger_violations:
+            problems.append(
+                f"resource ledger over-credited (double-accepted hedge "
+                f"done?): {ledger_violations}"
+            )
+        if len(base_lats) < 8:
+            problems.append(
+                f"baseline too thin: {len(base_lats)} tasks before t1"
+            )
+        if hedge_rate_baseline > 0.01:
+            problems.append(
+                f"hedge launch rate {hedge_rate_baseline:.2%} > 1% with "
+                f"no fault active"
+            )
+        if base_lats and slow_lats and p99(slow_lats) > bound * p99(base_lats):
+            problems.append(
+                f"straggler-window p99 {p99(slow_lats):.1f}s > "
+                f"{bound:g}x baseline p99 {p99(base_lats):.1f}s"
+            )
+        if pairs_won < min_pairs:
+            problems.append(
+                f"only {pairs_won} hedged pairs adjudicated "
+                f"(need >= {min_pairs})"
+            )
+        if len(set(tokens)) != len(tokens):
+            problems.append("duplicate task result observed")
+        if stats["blob_ok"] < n_blobs:
+            problems.append(
+                f"only {stats['blob_ok']}/{n_blobs} throttled blobs "
+                f"delivered"
+            )
+        if saw_quarantine and releads < 1:
+            problems.append("no PULL_RELEAD recorded under throttle")
+        if interleaved:
+            problems.append(
+                f"actor incarnations interleaved: {tokens_in_order}"
+            )
+        if not monotonic_ok:
+            problems.append("actor counter not monotonic within an epoch")
+        if final_ok < 4:
+            problems.append(
+                f"only {final_ok}/6 tasks completed after head restart"
+            )
+        if problems:
+            RESULTS["straggler_soak_ok"] = 0.0
+            raise RuntimeError(
+                f"straggler_soak FAILED (seed={seed}; reproduce with "
+                f"--only straggler_soak --chaos-seed {seed}): "
+                + "; ".join(problems)
+            )
+        RESULTS["straggler_soak_ok"] = 1.0
+    finally:
+        stop.set()
+        if cluster is not None:
+            for proc in list(cluster._daemons):
+                try:
+                    cluster.kill_node(proc)
+                except Exception:  # noqa: BLE001
+                    soak_errors["teardown"] += 1
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            soak_errors["teardown"] += 1
+        head.stop()
+        shutil.rmtree(session_dir, ignore_errors=True)
+
+
 @ray_tpu.remote(num_cpus=1, max_retries=2)
 def _pressure_fetch(chunk_refs, small_refs, get_timeout):
     """Pressure-soak consumer: one thread pulls the broadcast chunk
@@ -2236,7 +2778,7 @@ def main(argv=None) -> int:
         "--only", default=None,
         help="comma-separated subset: tasks,actors,objects,pgs,scale,"
         "object_envelope,chaos_soak,head_failover,pressure_soak,"
-        "partition_soak",
+        "partition_soak,straggler_soak",
     )
     parser.add_argument(
         "--envelope-smoke", action="store_true",
@@ -2260,6 +2802,12 @@ def main(argv=None) -> int:
         "--partition-smoke", action="store_true",
         help="short partition_soak config: 1 healthy node + 1 victim, "
         "one cut/heal cycle + 1 head kill (make partition-smoke)",
+    )
+    parser.add_argument(
+        "--straggler-smoke", action="store_true",
+        help="short straggler_soak config: 2 healthy nodes + 1 gray "
+        "victim, one slowexec+throttle cycle + 1 head kill "
+        "(make straggler-smoke)",
     )
     parser.add_argument(
         "--pressure-smoke", action="store_true",
@@ -2320,6 +2868,11 @@ def main(argv=None) -> int:
         partition_cfg["seed"] = args.chaos_seed
     if args.chaos_seconds is not None:
         partition_cfg["seconds"] = args.chaos_seconds
+    straggler_cfg = dict(
+        STRAGGLER_SMOKE if args.straggler_smoke else STRAGGLER_FULL
+    )
+    if args.chaos_seed is not None:
+        straggler_cfg["seed"] = args.chaos_seed
     groups = {
         "tasks": bench_tasks,
         "actors": bench_actor_calls,
@@ -2331,10 +2884,11 @@ def main(argv=None) -> int:
         "head_failover": lambda: bench_head_failover(failover_cfg),
         "pressure_soak": lambda: bench_pressure_soak(pressure_cfg),
         "partition_soak": lambda: bench_partition_soak(partition_cfg),
+        "straggler_soak": lambda: bench_straggler_soak(straggler_cfg),
     }
     _opt_in = (
         "object_envelope", "chaos_soak", "head_failover",
-        "pressure_soak", "partition_soak",
+        "pressure_soak", "partition_soak", "straggler_soak",
     )
     selected = (
         [s.strip() for s in args.only.split(",")]

@@ -140,10 +140,32 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
         # timeline alone.
         chaos_events = list_cluster_events(category="chaos", limit=100_000)
         cuts: Dict[str, Dict[str, Any]] = {}
+        throttles: Dict[str, Dict[str, Any]] = {}
         for ev in chaos_events:
             name, entity = ev["event"], ev["entity"]
             if name == "PARTITION_BEGIN":
                 cuts[entity] = ev
+                continue
+            if name == "THROTTLE_BEGIN":
+                throttles[entity] = ev
+                continue
+            if name == "THROTTLE_HEAL" and entity in throttles:
+                # Stragglers row (pid "stragglers"): the window a link
+                # ran degraded renders as one slice, so suspect edges,
+                # quarantines and hedges line up under the throttle
+                # that caused them.
+                t0 = throttles.pop(entity)["timestamp"]
+                trace.append(
+                    {
+                        "name": f"throttle:{entity}",
+                        "cat": "stragglers", "pid": "stragglers",
+                        "tid": entity, "ph": "X", "ts": t0 * 1e6,
+                        "dur": max(0.0, ev["timestamp"] - t0) * 1e6,
+                        "args": {
+                            **(ev.get("attrs") or {}), "entity": entity,
+                        },
+                    }
+                )
                 continue
             if name == "PARTITION_HEAL" and entity in cuts:
                 # Membership row (pid "membership"): the cut window a
@@ -179,6 +201,16 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
                     },
                 }
             )
+        # Unhealed throttles (still slow at dump time) stay visible.
+        for entity, ev in throttles.items():
+            trace.append(
+                {
+                    "name": f"throttle:{entity}", "cat": "stragglers",
+                    "pid": "stragglers", "tid": entity, "ph": "i",
+                    "ts": ev["timestamp"] * 1e6, "s": "g",
+                    "args": {**(ev.get("attrs") or {}), "entity": entity},
+                }
+            )
         # Unhealed cuts (still dark at dump time) stay visible.
         for entity, ev in cuts.items():
             trace.append(
@@ -200,6 +232,7 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
         # per session straight from the timeline.
         head_events = list_cluster_events(category="head", limit=100_000)
         downs: Dict[str, Dict[str, Any]] = {}
+        quarantines: Dict[str, Dict[str, Any]] = {}
         begin: Optional[Dict[str, Any]] = None
         for ev in head_events:
             name, entity = ev["event"], ev["entity"]
@@ -211,6 +244,47 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
             }
             if name == "HEAD_DOWN":
                 downs[entity] = ev
+                continue
+            if name == "HEALTH_SCORE":
+                # Counter track: the scorer's EWMA per node, so a
+                # node's decay/recovery is a curve under the throttle
+                # slice that drove it.
+                trace.append(
+                    {
+                        "name": f"health:{entity}", "cat": "stragglers",
+                        "pid": "stragglers", "ph": "C",
+                        "ts": ev["timestamp"] * 1e6,
+                        "args": {
+                            "score": (ev.get("attrs") or {}).get("score", 0)
+                        },
+                    }
+                )
+                continue
+            if name == "NODE_QUARANTINE":
+                quarantines[entity] = ev
+                continue
+            if name == "NODE_READMIT" and entity in quarantines:
+                t0 = quarantines.pop(entity)["timestamp"]
+                trace.append(
+                    {
+                        **base, "name": f"quarantine:{entity}",
+                        "cat": "stragglers", "pid": "stragglers",
+                        "ph": "X", "ts": t0 * 1e6,
+                        "dur": max(0.0, ev["timestamp"] - t0) * 1e6,
+                    }
+                )
+                continue
+            if name in (
+                "NODE_SUSPECT", "NODE_READMIT",
+                "HEDGE_LAUNCH", "HEDGE_WIN", "HEDGE_CANCEL",
+            ):
+                trace.append(
+                    {
+                        **base, "name": name, "cat": "stragglers",
+                        "pid": "stragglers", "ph": "i",
+                        "ts": ev["timestamp"] * 1e6, "s": "g",
+                    }
+                )
                 continue
             if name == "HEAD_RECONNECT" and entity in downs:
                 t0 = downs.pop(entity)["timestamp"]
@@ -253,6 +327,16 @@ def timeline(filename: Optional[str] = None) -> Optional[List[Dict]]:
             trace.append(
                 {**base, "name": name, "ph": "i",
                  "ts": ev["timestamp"] * 1e6, "s": "g"}
+            )
+        # Still-quarantined nodes at dump time stay visible.
+        for entity, ev in quarantines.items():
+            trace.append(
+                {
+                    "name": f"quarantine:{entity}", "cat": "stragglers",
+                    "pid": "stragglers", "tid": entity, "ph": "i",
+                    "ts": ev["timestamp"] * 1e6, "s": "g",
+                    "args": {**(ev.get("attrs") or {}), "entity": entity},
+                }
             )
         # Unpaired HEAD_DOWNs (reconnect never landed) stay visible.
         for entity, ev in downs.items():

@@ -360,6 +360,11 @@ class WorkerRuntime:
         # non-durable inflight/location tables.
         self._executing: Dict[bytes, tuple] = {}
         self._sealed_locs: "OrderedDict[bytes, str]" = OrderedDict()
+        # Hedge-loser cancellation (gray-failure tolerance): task ids
+        # the head told us lost their speculative race. The done report
+        # for a cancelled task skips value sealing — no pool bytes are
+        # committed for results the head will reject anyway.
+        self._cancelled: set = set()
         # Serializes execution across the main loop (GCS-routed tasks)
         # and direct-conn reader threads (inline fast calls): serial
         # workers run exactly one task at a time no matter which path
@@ -960,7 +965,15 @@ class WorkerRuntime:
         return_ids = spec.return_object_ids()
         results = [{"object_id": oid.binary()} for oid in return_ids]
         error_blob = None
-        if exc is not None:
+        cancelled = spec.task_id.binary() in self._cancelled
+        if cancelled and exc is None:
+            # Hedge loser (head sent cancel_task mid-execution): the
+            # winning twin's results are already durable in its done
+            # batcher, so sealing ours would only commit pool bytes
+            # the head must reject. Report a flagged done instead —
+            # the lease comes home, nothing touches the directory.
+            pass
+        elif exc is not None:
             if not isinstance(exc, (RayTaskError, RayActorError)):
                 exc = RayTaskError.from_exception(spec.name, exc)
             try:
@@ -1065,6 +1078,15 @@ class WorkerRuntime:
             # produced by a falsely-dead actor after its restart —
             # at-most-once across false death.
             item["actor_epoch"] = spec.actor_epoch
+        if getattr(spec, "hedge_seq", None) is not None:
+            # Hedge fence: echo which speculative twin produced this
+            # result so the head adjudicates first-done-wins and
+            # rejects the stale twin like a stale actor epoch.
+            item["hedge_seq"] = spec.hedge_seq
+        if cancelled:
+            item["hedge_cancelled"] = True
+        if getattr(spec, "grant_lat", None) is not None:
+            item["grant_lat"] = spec.grant_lat
         pinned_refs = list(spec.dependencies) + list(
             getattr(spec, "borrowed_refs", None) or ()
         )
@@ -1120,6 +1142,7 @@ class WorkerRuntime:
             else ()
         )
         _events.set_task_context(spec.task_id.hex())
+        t_exec0 = time.monotonic()
         try:
             value = self._run_user_code(spec)
             exc = None
@@ -1127,6 +1150,16 @@ class WorkerRuntime:
             value, exc = None, e
         finally:
             _events.set_task_context(None)
+        if _chaos._active is not None:
+            # Chaos: slowexec stretch — a cpu-starved machine would
+            # have taken factor x as long; the sleep (and the glob
+            # match) live inside the chaos engine, off when inactive.
+            _chaos.slowexec_stretch(
+                spec.name, time.monotonic() - t_exec0,
+                cancelled=lambda: (
+                    spec.task_id.binary() in self._cancelled
+                ),
+            )
         t_end = time.time() if _rec.enabled else 0.0
         if spec.num_returns == -1:
             # Failures before iteration (bad args, fetch error) must
@@ -1136,6 +1169,7 @@ class WorkerRuntime:
             return
         self._report_done(spec, value, exc, origin)
         self._executing.pop(tid_b, None)
+        self._cancelled.discard(tid_b)
         # t_fork truthy too: a mid-execution toggle-on must not ship a
         # half-captured span (0.0 boundaries poison the histograms).
         if _rec.enabled and t_fork:
@@ -1276,7 +1310,27 @@ def main():
                 # positional __reduce__ drops ad-hoc attrs): stamp it
                 # back on so the done record can echo the epoch.
                 s.actor_epoch = msg["actor_epoch"]
+            if msg.get("hedge_seq") is not None:
+                # Same message-rider pattern for the hedge fence: the
+                # done record echoes which speculative twin ran.
+                s.hedge_seq = msg["hedge_seq"]
+            if msg.get("t_grant") is not None:
+                # Health signal: how long the lease grant spent in
+                # flight (a throttled link stretches this 10-100x).
+                # Echoed in the done record for the head's scorer.
+                s.grant_lat = max(0.0, time.time() - msg["t_grant"])
             task_queue.put((s, None))
+        elif t == "cancel_task":
+            # Hedge-loser cancellation: the head picked the other twin.
+            # Python can't preempt user code mid-frame, so the mark
+            # makes the eventual done report skip value sealing (no
+            # pool bytes committed) and carry the cancelled flag; a
+            # task that already finished has nothing to cancel.
+            rt = rt_holder.get("rt")
+            if rt is not None:
+                tid = msg.get("task_id")
+                if tid in rt._executing:
+                    rt._cancelled.add(tid)
         elif t == "terminate_actor":
             # Force-kill of ONE packed actor on a shared host (the
             # process-level SIGKILL of a dedicated actor worker doesn't

@@ -206,12 +206,31 @@ def cmd_list(args):
     columns = {
         "actors": ["actor_id", "name", "state", "class_name"],
         "tasks": ["task_id", "name", "state", "worker_id"],
-        "nodes": ["node_id", "alive", "label", "total"],
+        "nodes": ["node_id", "alive", "label", "total", "health_score",
+                  "quarantined"],
         "workers": ["worker_id", "state", "pid", "num_inflight"],
         "objects": ["object_id", "status", "size", "inline"],
         "placement_groups": ["placement_group_id", "state", "strategy"],
     }[args.kind]
     _print_table(items, columns)
+
+
+def cmd_nodes(args):
+    """Per-node gray-failure health: scorer EWMA, quarantine flag, and
+    the hedge won/lost scoreboard."""
+    _connect()
+    from ray_tpu.util.state import list_nodes
+
+    items = list_nodes()
+    for it in items:
+        it["hedges_won_lost"] = (
+            f"{it.get('hedges_won', 0)}/{it.get('hedges_lost', 0)}"
+        )
+    _print_table(
+        items,
+        ["node_id", "alive", "label", "health_score", "quarantined",
+         "hedges_won_lost"],
+    )
 
 
 def cmd_summary(args):
@@ -418,6 +437,10 @@ def main(argv=None):
         help="session name from the list (default: the only one)",
     )
     sp.set_defaults(fn=cmd_debug)
+
+    sub.add_parser(
+        "nodes", help="per-node health (gray-failure scorer)"
+    ).set_defaults(fn=cmd_nodes)
 
     sp = sub.add_parser("summary", help="summarize tasks")
     sp.add_argument("kind", choices=["tasks"])

@@ -713,7 +713,7 @@ class _BlockingFetcher:
         self.order = []
         self.fail = set()
 
-    def pull(self, oid, address, timeout=None):
+    def pull(self, oid, address, timeout=None, resolve=None):
         self.order.append(oid.binary())
         self.release.wait(timeout)
         return oid.binary() not in self.fail
